@@ -1,0 +1,26 @@
+"""sirius_tpu — a TPU-native Kohn-Sham DFT framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+electronic-structure/SIRIUS (plane-wave + LAPW Kohn-Sham DFT): pseudopotential
+plane-wave SCF with norm-conserving / ultrasoft / PAW pseudopotentials,
+magnetism, Hubbard corrections, forces/stress, and distributed execution over
+TPU meshes via jax.sharding + shard_map collectives.
+
+Design stance (vs. the reference, see SURVEY.md):
+  - fields and wave functions are pytrees of jnp arrays; the SCF step is a
+    pure function, jit-compiled end to end;
+  - parallelism is a jax.sharding.Mesh with axes ("k", "b", "g") instead of
+    MPI communicator grids; collectives are lax.psum / all_to_all / all_gather;
+  - hot ops (H·psi local part, beta projections, density accumulation) are
+    batched MXU-friendly einsums + batched FFTs instead of per-band loops.
+
+Precision: double precision is enabled at import (DFT energies need f64
+accumulation); the wave-function hot path dtype is configurable (complex64
+for TPU MXU throughput, complex128 for strict verification).
+"""
+
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

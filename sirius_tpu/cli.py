@@ -1,0 +1,30 @@
+"""sirius-scf command-line mini-app (reference: apps/mini_app/sirius.scf.cpp).
+
+Round-1 stub: argument surface is in place; SCF driving lands with the dft
+layer. Exits with a clear message rather than ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sirius-scf",
+        description="TPU-native Kohn-Sham DFT SCF mini-app (sirius_tpu)",
+    )
+    p.add_argument("input", nargs="?", default="sirius.json", help="JSON input file")
+    p.add_argument("--test_against", help="reference output JSON to compare against")
+    args = p.parse_args(argv)
+    try:
+        from sirius_tpu.dft.scf import run_scf_from_file
+    except ImportError:
+        print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
+        return 2
+    return run_scf_from_file(args.input, test_against=args.test_against)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,12 @@
+"""Core numerics substrate: G-vectors, FFT grids, radial splines, spherical
+harmonics, spherical Bessel functions, linear algebra helpers.
+
+This layer replaces the reference's src/core/ (mdarray, Gvec, SpFFT wrappers,
+SHT, sf) with host-side numpy setup + device-resident jnp tables.
+"""
+
+from sirius_tpu.core.gvec import Gvec, GkVec
+from sirius_tpu.core.fftgrid import FFTGrid, good_fft_size
+from sirius_tpu.core.radial import RadialGrid, Spline
+from sirius_tpu.core.sht import ylm_real, ylm_complex, gaunt_rlm, gaunt_ylm, lm_index
+from sirius_tpu.core.sbessel import spherical_jn

@@ -1,0 +1,120 @@
+"""FFT grid: box dimensioning and batched G<->r transforms.
+
+Replaces the reference's fft::Grid / SpFFT wrappers (src/core/fft/fft3d_grid.hpp,
+fft.hpp:29-95). On TPU there is no slab decomposition: single-chip transforms
+are whole-box batched jnp.fft calls (XLA lowers these well); the distributed
+path lives in sirius_tpu.parallel (shard_map + all_to_all over the "g" axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FFT-friendly sizes: products of 2,3,5,7 (XLA/TPU handles these efficiently).
+_SMOOTH_PRIMES = (2, 3, 5, 7)
+
+
+def _is_smooth(n: int) -> bool:
+    for p in _SMOOTH_PRIMES:
+        while n % p == 0:
+            n //= p
+    return n == 1
+
+
+def good_fft_size(n: int) -> int:
+    """Smallest 7-smooth integer >= n (reference: fft3d_grid.hpp find_grid_size)."""
+    n = max(1, int(n))
+    while not _is_smooth(n):
+        n += 1
+    return n
+
+
+@dataclasses.dataclass(frozen=True)
+class FFTGrid:
+    """A real-space/reciprocal-space FFT box.
+
+    dims: (n1, n2, n3) grid divisions along the three lattice vectors.
+    The flattened ("linear") index convention is row-major over (i1, i2, i3),
+    matching jnp reshape of an array of shape dims.
+    """
+
+    dims: tuple[int, int, int]
+
+    @staticmethod
+    def for_cutoff(lattice: np.ndarray, gmax: float) -> "FFTGrid":
+        """Minimal box holding the |G| <= gmax sphere.
+
+        lattice: rows are lattice vectors a_i (bohr). The box needs
+        n_i >= 2*m_i + 1 where m_i is the max Miller index along b_i inside
+        the sphere: m_i = floor(gmax * |a_i| / (2 pi)).
+        """
+        a = np.asarray(lattice, dtype=np.float64)
+        lens = np.linalg.norm(a, axis=1)
+        m = np.floor(gmax * lens / (2 * np.pi)).astype(int)
+        dims = tuple(good_fft_size(int(2 * mi + 2)) for mi in m)
+        return FFTGrid(dims)
+
+    @property
+    def num_points(self) -> int:
+        n1, n2, n3 = self.dims
+        return n1 * n2 * n3
+
+    def grid_coords(self) -> np.ndarray:
+        """Fractional coordinates of all grid points, shape (N, 3)."""
+        n1, n2, n3 = self.dims
+        i1, i2, i3 = np.meshgrid(
+            np.arange(n1), np.arange(n2), np.arange(n3), indexing="ij"
+        )
+        frac = np.stack(
+            [i1.ravel() / n1, i2.ravel() / n2, i3.ravel() / n3], axis=1
+        )
+        return frac
+
+    def miller_to_linear(self, millers: np.ndarray) -> np.ndarray:
+        """Map integer Miller indices (h,k,l) -> flattened FFT box index.
+
+        Negative frequencies wrap (h mod n1), matching the standard DFT
+        frequency layout used by jnp.fft.fftn.
+        """
+        n1, n2, n3 = self.dims
+        h = np.mod(millers[:, 0], n1)
+        k = np.mod(millers[:, 1], n2)
+        l = np.mod(millers[:, 2], n3)
+        return ((h * n2 + k) * n3 + l).astype(np.int32)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def g_to_r(coeffs: jax.Array, fft_index: jax.Array, dims: tuple[int, int, int]) -> jax.Array:
+    """Batched G -> r transform: scatter PW coefficients into the box and
+    inverse-FFT.  coeffs: [..., ng]; returns [..., n1, n2, n3].
+
+    Convention: f(r) = sum_G f(G) e^{iGr}  ==  N * ifftn(box)  (numpy ifft
+    normalizes by 1/N).
+    """
+    batch = coeffs.shape[:-1]
+    n = dims[0] * dims[1] * dims[2]
+    box = jnp.zeros(batch + (n,), dtype=coeffs.dtype)
+    # Additive scatter: indices within a G-sphere are unique, and padded slots
+    # of GkVec (index 0, coefficient 0) then contribute nothing.
+    box = box.at[..., fft_index].add(coeffs)
+    box = box.reshape(batch + dims)
+    return jnp.fft.ifftn(box, axes=(-3, -2, -1)) * n
+
+
+@partial(jax.jit, static_argnums=(2,))
+def r_to_g(values: jax.Array, fft_index: jax.Array, dims: tuple[int, int, int]) -> jax.Array:
+    """Batched r -> G transform: FFT the box and gather sphere coefficients.
+
+    values: [..., n1, n2, n3]; returns [..., ng].
+    Convention: f(G) = (1/N) sum_r f(r) e^{-iGr} == fftn(values)/N.
+    """
+    n = dims[0] * dims[1] * dims[2]
+    box = jnp.fft.fftn(values, axes=(-3, -2, -1)) / n
+    batch = values.shape[:-3]
+    box = box.reshape(batch + (n,))
+    return box[..., fft_index]

@@ -1,0 +1,30 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh so that
+multi-chip sharding paths are exercised without TPU hardware (the driver
+separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: a pytest plugin imports jax before this conftest runs, so env-var
+configuration (JAX_PLATFORMS / XLA_FLAGS) is too late; jax.config still works
+because no backend has been initialized yet."""
+
+import os
+
+import jax
+import pytest
+
+# Force CPU: the suite needs f64/c128 (unsupported on TPU) and a virtual
+# multi-device mesh. Set SIRIUS_TPU_TEST_PLATFORM to override.
+jax.config.update("jax_platforms", os.environ.get("SIRIUS_TPU_TEST_PLATFORM", "cpu"))
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_ROOT, "verification"))
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="reference verification data not mounted at /root/reference",
+)

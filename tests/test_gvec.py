@@ -1,0 +1,79 @@
+"""G-vector engine tests (mirrors reference apps/unit_tests/test_gvec.cpp:
+index round-trips, completeness of the sphere, shell ordering)."""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.core import Gvec, GkVec, FFTGrid
+from sirius_tpu.core.gvec import reciprocal_lattice
+
+
+@pytest.fixture(scope="module")
+def si_lattice():
+    a = 10.26
+    return a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+
+
+def test_reciprocal_orthogonality(si_lattice):
+    b = reciprocal_lattice(si_lattice)
+    assert np.allclose(si_lattice @ b.T, 2 * np.pi * np.eye(3))
+
+
+def test_sphere_complete_and_sorted(si_lattice):
+    gv = Gvec.build(si_lattice, gmax=8.0)
+    # all |G| <= gmax, sorted ascending
+    glen = np.sqrt(gv.glen2)
+    assert glen.max() <= 8.0 + 1e-8
+    assert np.all(np.diff(glen) > -1e-8)
+    # G=0 first
+    assert np.all(gv.millers[0] == 0)
+    # completeness: brute-force count over a larger box
+    b = gv.recip
+    n = 20
+    rng = np.arange(-n, n + 1)
+    hh, kk, ll = np.meshgrid(rng, rng, rng, indexing="ij")
+    m = np.stack([hh.ravel(), kk.ravel(), ll.ravel()], axis=1)
+    g2 = np.sum((m @ b) ** 2, axis=1)
+    assert gv.num_gvec == int(np.sum(g2 <= 64.0 + 1e-8))
+    # inversion symmetry of the set
+    idx = gv.index_of_millers(-gv.millers)
+    assert np.all(idx >= 0)
+
+
+def test_shells(si_lattice):
+    gv = Gvec.build(si_lattice, gmax=6.0)
+    # shell values strictly increasing; every G maps to its shell value
+    assert np.all(np.diff(gv.shell_g2) > 0)
+    assert np.allclose(gv.shell_g2[gv.shell_idx], gv.glen2, atol=1e-6)
+
+
+def test_fft_index_roundtrip(si_lattice):
+    gv = Gvec.build(si_lattice, gmax=8.0)
+    # unique indices, and decoding the linear index reproduces the Miller set
+    assert len(np.unique(gv.fft_index)) == gv.num_gvec
+    n1, n2, n3 = gv.fft.dims
+    h = gv.fft_index // (n2 * n3)
+    k = (gv.fft_index // n3) % n2
+    l = gv.fft_index % n3
+    dec = np.stack([h, k, l], axis=1).astype(np.int64)
+    # wrap back to signed
+    dims = np.array([n1, n2, n3])
+    signed = (dec + dims // 2) % dims - dims // 2
+    assert np.all(signed == (gv.millers + dims // 2) % dims - dims // 2)
+
+
+def test_gkvec_padding(si_lattice):
+    gv = Gvec.build(si_lattice, gmax=12.0)
+    fft = FFTGrid.for_cutoff(si_lattice, 2 * 6.0)
+    kpts = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25], [0.5, 0, 0]])
+    gk = GkVec.build(gv, kpts, gk_cutoff=6.0, fft=fft)
+    assert gk.num_kpoints == 3
+    assert gk.millers.shape[1] == gk.num_gk.max()
+    for ik in range(3):
+        n = gk.num_gk[ik]
+        lens = np.linalg.norm(gk.gkcart[ik, :n], axis=1)
+        assert lens.max() <= 6.0 + 1e-8
+        assert np.all(gk.mask[ik, :n] == 1.0)
+        assert np.all(gk.mask[ik, n:] == 0.0)
+    # Gamma sphere is inversion symmetric
+    assert gk.num_gk[0] % 2 == 1

@@ -1,0 +1,64 @@
+"""Radial spline / integral tests (mirrors reference test_spline_*)."""
+
+import numpy as np
+
+from sirius_tpu.core.radial import (
+    RadialGrid,
+    RadialIntegralTable,
+    Spline,
+    sbessel_integral,
+)
+from sirius_tpu.core.sbessel import spherical_jn, spherical_jn_jax
+
+
+def test_spline_interp_and_integrate():
+    g = RadialGrid.exponential(1e-6, 40.0, 1000)
+    f = np.exp(-g.r) * np.sin(g.r)
+    s = Spline(g, f)
+    # int_0^inf e^-r sin r dr = 1/2
+    np.testing.assert_allclose(s.integrate(0), 0.5, atol=1e-7)
+    # int e^-r sin(r) r^2 dr = Im int r^2 e^{-(1-i)r} = Im 2/(1-i)^3 = 0.5
+    np.testing.assert_allclose(s.integrate(2), 0.5, atol=1e-7)
+    x = np.linspace(0.1, 9.0, 50)
+    np.testing.assert_allclose(s(x), np.exp(-x) * np.sin(x), atol=1e-8)
+
+
+def test_sbessel_integral_analytic():
+    # int_0^inf e^{-r} j_0(qr) r^2 dr = 2/(1+q^2)^2
+    g = RadialGrid.exponential(1e-7, 40.0, 1200)
+    f = np.exp(-g.r)
+    q = np.array([0.0, 0.5, 1.0, 3.0, 8.0])
+    got = sbessel_integral(g.r, f, 0, q)
+    np.testing.assert_allclose(got, 2.0 / (1 + q**2) ** 2, rtol=1e-7)
+    # l=1: int e^-r j_1(qr) r^2 dr = 2q / (1+q^2)^2... (Hankel of r e^-r)
+    got1 = sbessel_integral(g.r, f, 1, q[1:])
+    q1 = q[1:]
+    np.testing.assert_allclose(got1, 2 * q1 / (1 + q1**2) ** 2, rtol=1e-6)
+
+
+def test_radial_integral_table_interpolation():
+    g = RadialGrid.exponential(1e-7, 40.0, 1200)
+    f = np.exp(-g.r ** 2)
+    tab = RadialIntegralTable.build(g.r, f[None, :], np.array([0]), qmax=10.0)
+    q = np.array([0.3, 1.7, 5.2, 9.9])
+    exact = sbessel_integral(g.r, f, 0, q)
+    np.testing.assert_allclose(tab(q)[0], exact, rtol=1e-6, atol=1e-10)
+
+
+def test_spherical_jn_jax_matches_scipy():
+    # include the zeros of j0 (pi, 2pi, ...) where naive Miller normalization
+    # against j0 suffers catastrophic cancellation
+    x = np.concatenate(
+        [
+            np.linspace(0.0, 30.0, 400),
+            np.pi * np.arange(1, 9),
+            np.pi * np.arange(1, 9) + 1e-9,
+            [1e-6, 1e-4, 5e-4],
+        ]
+    )
+    got = np.asarray(spherical_jn_jax(8, x))
+    for l in range(9):
+        np.testing.assert_allclose(
+            got[:, l], spherical_jn(l, x), atol=1e-10,
+            err_msg=f"l={l}",
+        )
