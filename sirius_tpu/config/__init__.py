@@ -1,0 +1,10 @@
+from sirius_tpu.config.schema import (
+    Config,
+    ControlConfig,
+    IterativeSolverConfig,
+    MixerConfig,
+    ParametersConfig,
+    SettingsConfig,
+    UnitCellConfig,
+    load_config,
+)

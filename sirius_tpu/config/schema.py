@@ -1,0 +1,200 @@
+"""Input configuration, compatible with the reference JSON schema.
+
+The reference's single source of truth is src/context/input_schema.json
+(sections control/parameters/iterative_solver/mixer/settings/unit_cell/
+nlcg/vcsqnm/hubbard) from which typed accessors are generated
+(src/context/config.hpp). Here each section is a dataclass whose field names
+and defaults match the schema keys, so reference input decks
+(verification/test*/sirius.json) load unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass
+class ControlConfig:
+    # reference input_schema.json "control" section
+    processing_unit: str = "auto"
+    verbosity: int = 1
+    verification: int = 0
+    print_forces: bool = False
+    print_stress: bool = False
+    print_neighbors: bool = False
+    output: str = "stdout:"
+    mpi_grid_dims: list = dataclasses.field(default_factory=lambda: [1, 1])
+    std_evp_solver_name: str = "auto"
+    gen_evp_solver_name: str = "auto"
+    fft_mode: str = "parallel"
+    reduce_gvec: bool = True
+    rmt_max: float = 2.2
+    spglib_tolerance: float = 1e-6
+    cyclic_block_size: int = -1
+    beta_chunk_size: int = 256
+    beta_on_device: bool = False
+    ortho_rf: bool = False
+    save_rf: bool = False
+    use_second_variation: bool = True
+
+
+@dataclasses.dataclass
+class ParametersConfig:
+    # reference input_schema.json "parameters" section defaults
+    electronic_structure_method: str = "pseudopotential"
+    xc_functionals: list = dataclasses.field(default_factory=list)
+    core_relativity: str = "dirac"
+    valence_relativity: str = "zora"
+    num_bands: int = -1
+    num_fv_states: int = -1
+    smearing_width: float = 0.01  # Ha
+    smearing: str = "gaussian"
+    pw_cutoff: float = 0.0  # bohr^-1, density/potential sphere
+    gk_cutoff: float = 0.0  # bohr^-1, |G+k| sphere
+    aw_cutoff: float = 0.0  # LAPW rgkmax
+    lmax_apw: int = 8
+    lmax_rho: int = 8
+    lmax_pot: int = 8
+    num_mag_dims: int = 0  # 0: none, 1: collinear, 3: non-collinear
+    auto_rmt: int = 1
+    ngridk: list = dataclasses.field(default_factory=lambda: [1, 1, 1])
+    shiftk: list = dataclasses.field(default_factory=lambda: [0, 0, 0])
+    vk: list = dataclasses.field(default_factory=list)
+    num_dft_iter: int = 100
+    energy_tol: float = 1e-6
+    density_tol: float = 1e-6
+    molecule: bool = False
+    gamma_point: bool = False
+    so_correction: bool = False
+    hubbard_correction: bool = False
+    use_symmetry: bool = True
+    use_ibz: bool = True
+    nn_radius: float = -1
+    extra_charge: float = 0
+    use_scf_correction: bool = True
+    precision_wf: str = "fp64"
+    precision_hs: str = "fp64"
+    precision_gs: str = "auto"
+
+    @property
+    def num_spins(self) -> int:
+        return 2 if self.num_mag_dims > 0 else 1
+
+    @property
+    def num_spinor_comp(self) -> int:
+        return 2 if self.num_mag_dims == 3 else 1
+
+
+@dataclasses.dataclass
+class IterativeSolverConfig:
+    # reference input_schema.json "iterative_solver" section
+    type: str = "auto"  # davidson | exact | auto
+    num_steps: int = 20
+    subspace_size: int = 2
+    locking: bool = True
+    early_restart: float = 0.5
+    energy_tolerance: float = 1e-2
+    residual_tolerance: float = 1e-6
+    relative_tolerance: float = 0
+    empty_states_tolerance: float = 0
+    min_tolerance: float = 1e-13
+    converge_by_energy: int = 1
+    min_num_res: int = 0
+    init_eval_old: bool = True
+    init_subspace: str = "lcao"
+    extra_ortho: bool = False
+    min_occupancy: float = 1e-14
+
+
+@dataclasses.dataclass
+class MixerConfig:
+    # reference input_schema.json "mixer" section
+    type: str = "anderson"  # linear | anderson | anderson_stable | broyden2
+    beta: float = 0.7
+    beta0: float = 0.15
+    max_history: int = 8
+    beta_scaling_factor: float = 1.0
+    use_hartree: bool = False
+    rms_min: float = 1e-16
+
+
+@dataclasses.dataclass
+class SettingsConfig:
+    # reference input_schema.json "settings" section (subset in use)
+    nprii_vloc: int = 200
+    nprii_beta: int = 20
+    nprii_aug: int = 20
+    nprii_rho_core: int = 20
+    fft_grid_size: list = dataclasses.field(default_factory=lambda: [0, 0, 0])
+    use_coarse_fft_grid: bool = True
+    pseudo_grid_cutoff: float = 10.0
+    itsol_tol_min: float = 1e-13
+    itsol_tol_ratio: float = 0
+    itsol_tol_scale: list = dataclasses.field(default_factory=lambda: [0.1, 0.5])
+    min_occupancy: float = 1e-14
+    mixer_rms_min: float = 1e-16
+    auto_enu_tol: float = 0
+
+
+@dataclasses.dataclass
+class UnitCellConfig:
+    lattice_vectors: list = dataclasses.field(default_factory=lambda: [[1, 0, 0], [0, 1, 0], [0, 0, 1]])
+    lattice_vectors_scale: float = 1.0
+    atom_types: list = dataclasses.field(default_factory=list)
+    atom_files: dict = dataclasses.field(default_factory=dict)
+    atoms: dict = dataclasses.field(default_factory=dict)
+    atom_coordinate_units: str = "lattice"
+
+
+_SECTION_TYPES = {
+    "control": ControlConfig,
+    "parameters": ParametersConfig,
+    "iterative_solver": IterativeSolverConfig,
+    "mixer": MixerConfig,
+    "settings": SettingsConfig,
+    "unit_cell": UnitCellConfig,
+}
+
+
+@dataclasses.dataclass
+class Config:
+    control: ControlConfig = dataclasses.field(default_factory=ControlConfig)
+    parameters: ParametersConfig = dataclasses.field(default_factory=ParametersConfig)
+    iterative_solver: IterativeSolverConfig = dataclasses.field(default_factory=IterativeSolverConfig)
+    mixer: MixerConfig = dataclasses.field(default_factory=MixerConfig)
+    settings: SettingsConfig = dataclasses.field(default_factory=SettingsConfig)
+    unit_cell: UnitCellConfig = dataclasses.field(default_factory=UnitCellConfig)
+    # sections parsed but not yet consumed (hubbard, nlcg, vcsqnm)
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Config":
+        cfg = Config()
+        for sec, val in d.items():
+            typ = _SECTION_TYPES.get(sec)
+            if typ is None:
+                cfg.extra[sec] = val
+                continue
+            section = getattr(cfg, sec)
+            known = {f.name for f in dataclasses.fields(typ)}
+            for k, v in val.items():
+                if k in known:
+                    setattr(section, k, v)
+                else:
+                    cfg.extra.setdefault(sec, {})[k] = v
+        return cfg
+
+    def to_dict(self) -> dict:
+        out = {}
+        for sec in _SECTION_TYPES:
+            out[sec] = dataclasses.asdict(getattr(self, sec))
+        return out
+
+
+def load_config(path_or_dict: str | dict) -> Config:
+    if isinstance(path_or_dict, dict):
+        return Config.from_dict(path_or_dict)
+    with open(path_or_dict) as f:
+        return Config.from_dict(json.load(f))

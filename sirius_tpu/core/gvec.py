@@ -45,12 +45,16 @@ def _enumerate_sphere(
     # so the box half-dims must cover t_i + |c_i|.
     a = 2.0 * np.pi * np.linalg.inv(recip).T  # rows a_i (recip = 2pi inv(A)^T)
     t = gmax * np.linalg.norm(a, axis=1) / (2.0 * np.pi)
-    need = np.ceil(t + np.abs(center) - 1e-9).astype(int)
-    half = np.array([d // 2 for d in fft.dims])
-    if np.any(need > half):
+    # enumeration covers h_i in [-(n_i//2), (n_i-1)//2]; the sphere needs
+    # h_i in [ceil(-t_i - c_i), floor(t_i - c_i)] (asymmetric for even dims)
+    dims = np.asarray(fft.dims)
+    hi_need = np.floor(t - center + 1e-9).astype(int)
+    lo_need = np.ceil(-t - center - 1e-9).astype(int)
+    if np.any(hi_need > (dims - 1) // 2) or np.any(lo_need < -(dims // 2)):
         raise ValueError(
             f"FFT box {fft.dims} too small for |G+k| <= {gmax} sphere at "
-            f"k={center}: need half-dims >= {need}, have {half}"
+            f"k={center}: need Miller range [{lo_need}, {hi_need}], have "
+            f"[{-(dims // 2)}, {(dims - 1) // 2}]"
         )
     n1, n2, n3 = fft.dims
     h = np.arange(-(n1 // 2), (n1 - 1) // 2 + 1)

@@ -86,12 +86,9 @@ def spline_quadrature_weights(r: np.ndarray) -> np.ndarray:
     key = (len(r), float(r[0]), float(r[-1]), hash(r.tobytes()))
     w = _QUAD_WEIGHT_CACHE.get(key)
     if w is None:
-        n = len(r)
-        eye = np.eye(n)
-        w = np.empty(n)
         # cardinal-basis integrals; CubicSpline supports vectorized values, so
         # spline all n unit vectors in one call
-        cs = CubicSpline(r, eye, axis=0, bc_type="not-a-knot")
+        cs = CubicSpline(r, np.eye(len(r)), axis=0, bc_type="not-a-knot")
         anti = cs.antiderivative()
         w = anti(r[-1]) - anti(r[0])
         _QUAD_WEIGHT_CACHE[key] = w
@@ -149,7 +146,16 @@ class RadialIntegralTable:
         return RadialIntegralTable(qgrid=qgrid, table=tab)
 
     def __call__(self, q: np.ndarray) -> np.ndarray:
-        """Interpolate every tabulated function at q; returns (..., len(q))."""
-        q = np.clip(np.asarray(q, dtype=np.float64), self.qgrid[0], self.qgrid[-1])
+        """Interpolate every tabulated function at q; returns (..., len(q)).
+
+        Raises on q beyond the tabulated range — silent flat extrapolation
+        would poison high-G physics (the reference's Radial_integrals::iqdq
+        throws likewise, radial_integrals.hpp:67)."""
+        q = np.asarray(q, dtype=np.float64)
+        if q.size and float(q.max()) > self.qgrid[-1] * (1 + 1e-12) + 1e-12:
+            raise ValueError(
+                f"q={float(q.max()):.6g} beyond table qmax={self.qgrid[-1]:.6g}"
+            )
+        q = np.clip(q, self.qgrid[0], self.qgrid[-1])
         out = self._interp(q)
         return out.reshape(self.table.shape[:-1] + q.shape)

@@ -28,9 +28,11 @@ def spherical_jn_jax(lmax: int, x: jnp.ndarray) -> jnp.ndarray:
     """
     x = jnp.asarray(x)
     ax = jnp.abs(x)
-    xs = jnp.where(ax < 1e-4, 1e-4, x)  # clamped argument for recurrences
-    # --- upward pass (valid where x > l) ---
-    up = [jnp.sinc(x / jnp.pi)]  # j0 = sin x / x with correct x->0 limit
+    # work on |x| throughout; parity j_l(-x) = (-1)^l j_l(x) is applied at
+    # the end so every branch is consistently signed
+    xs = jnp.where(ax < 1e-4, 1e-4, ax)  # clamped argument for recurrences
+    # --- upward pass (valid where |x| > l) ---
+    up = [jnp.sinc(ax / jnp.pi)]  # j0 = sin x / x with correct x->0 limit
     if lmax >= 1:
         up.append(jnp.sin(xs) / xs**2 - jnp.cos(xs) / xs)
     for l in range(1, lmax):
@@ -59,5 +61,7 @@ def spherical_jn_jax(lmax: int, x: jnp.ndarray) -> jnp.ndarray:
     dfact = np.array(
         [float(np.prod(np.arange(2 * l + 1, 0, -2, dtype=np.float64))) for l in range(lmax + 1)]
     )
-    series = x[..., None] ** ls / dfact * (1.0 - x[..., None] ** 2 / (2.0 * (2 * ls + 3)))
-    return jnp.where(ax[..., None] < 1e-4, series, out)
+    series = ax[..., None] ** ls / dfact * (1.0 - ax[..., None] ** 2 / (2.0 * (2 * ls + 3)))
+    out = jnp.where(ax[..., None] < 1e-4, series, out)
+    parity = jnp.where((x[..., None] < 0) & (ls.astype(jnp.int32) % 2 == 1), -1.0, 1.0)
+    return out * parity

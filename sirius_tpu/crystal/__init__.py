@@ -1,0 +1,4 @@
+from sirius_tpu.crystal.atom_type import AtomType, BetaProjector, AtomicWf
+from sirius_tpu.crystal.unit_cell import UnitCell
+from sirius_tpu.crystal.symmetry import CrystalSymmetry, SymmetryOp
+from sirius_tpu.crystal.kpoints import irreducible_kmesh

@@ -1,0 +1,149 @@
+"""Crystal symmetry, found natively (no spglib dependency).
+
+The reference delegates to spglib (src/symmetry/crystal_symmetry.cpp:210
+spg_get_dataset) and then filters magnetic symmetry. Here the space-group
+operations are found directly with the textbook algorithm spglib itself uses:
+
+  1. candidate rotations = integer matrices W (fractional basis) with
+     det W = +-1 that preserve the lattice metric  W M W^T = M,  M = A A^T;
+  2. for each W, candidate translations t = x_j - W x_0 against atoms of the
+     least-abundant species; (W, t) is kept if it permutes every atom onto an
+     atom of the same species (mod lattice) within tolerance;
+  3. collinear/non-collinear magnetic structures filter ops that do not
+     preserve the initial moments (reference magnetization symmetry check).
+
+Each op also records the induced atom permutation (needed to symmetrize
+forces and on-site matrices) and the integer reciprocal rotation
+W_k = (W^{-1})^T acting on fractional k / G vectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_TOL = 1e-6
+_ROTATION_CACHE: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class SymmetryOp:
+    w: np.ndarray  # (3,3) int rotation, fractional (real space): x' = W x + t
+    t: np.ndarray  # (3,) translation, fractional
+    perm: np.ndarray  # (natom,) atom a maps onto atom perm[a]
+    w_k: np.ndarray  # (3,3) int reciprocal rotation (W^{-1})^T
+    rot_cart: np.ndarray  # (3,3) cartesian rotation matrix
+
+
+def _lattice_rotations(lattice: np.ndarray) -> np.ndarray:
+    """All integer fractional rotations preserving the metric (point group of
+    the empty lattice, up to 48 ops for cubic).
+
+    Returned matrices are COLUMN-acting on fractional coordinates
+    (x' = W x): basis rows transform as A' = W^T A, so the metric condition
+    is W^T (A A^T) W = A A^T."""
+    m = lattice @ lattice.T
+    key = hash(np.round(m / max(1.0, np.abs(m).max()), 9).tobytes())
+    cached = _ROTATION_CACHE.get(key)
+    if cached is not None:
+        return cached
+    base = np.arange(5**9, dtype=np.int64)
+    digits = np.stack([(base // 5**p) % 5 - 2 for p in range(9)], axis=1)
+    cand = digits.reshape(-1, 3, 3)
+    det = np.linalg.det(cand).round().astype(np.int64)
+    cand = cand[np.abs(det) == 1]
+    mm = np.einsum("nji,jk,nkl->nil", cand, m, cand)  # W^T M W
+    keep = np.all(np.abs(mm - m[None]) < _TOL * max(1.0, np.abs(m).max()), axis=(1, 2))
+    out = cand[keep]
+    _ROTATION_CACHE[key] = out
+    return out
+
+
+def find_symmetry(
+    lattice: np.ndarray,
+    positions: np.ndarray,
+    species: np.ndarray,
+    moments: np.ndarray | None = None,
+    num_mag_dims: int = 0,
+    tol: float = _TOL,
+) -> list[SymmetryOp]:
+    positions = np.asarray(positions, dtype=np.float64)
+    species = np.asarray(species)
+    natom = len(positions)
+    rots = _lattice_rotations(np.asarray(lattice, dtype=np.float64))
+    inv_lat_t = np.linalg.inv(lattice.T)
+    ops: list[SymmetryOp] = []
+    # pivot species: least abundant
+    counts = {s: int(np.sum(species == s)) for s in set(species.tolist())}
+    pivot_s = min(counts, key=counts.get)
+    pivot_atoms = np.nonzero(species == pivot_s)[0]
+    x0 = positions[pivot_atoms[0]]
+    for w in rots:
+        wx = positions @ w.T  # (natom, 3): W x_a
+        seen_t: list[np.ndarray] = []
+        for j in pivot_atoms:
+            t = np.mod(positions[j] - w @ x0, 1.0)
+            if any(np.all(np.minimum(d := np.abs(t - ts), 1 - d) < tol) for ts in seen_t):
+                continue
+            mapped = np.mod(wx + t, 1.0)
+            # distance to every atom, on the torus
+            d = np.abs(mapped[:, None, :] - positions[None, :, :])
+            d = np.minimum(d, 1.0 - d)
+            match = np.all(d < tol, axis=2)  # (a, b): W x_a + t == x_b
+            perm = np.full(natom, -1, dtype=np.int64)
+            ok = True
+            for a in range(natom):
+                hits = np.nonzero(match[a])[0]
+                if len(hits) != 1 or species[hits[0]] != species[a]:
+                    ok = False
+                    break
+                perm[a] = hits[0]
+            if not ok or len(set(perm.tolist())) != natom:
+                continue
+            rot_cart = lattice.T @ w @ inv_lat_t
+            if moments is not None and num_mag_dims > 0:
+                # moments are axial vectors: m' = det(R) R m; collinear case
+                # requires preservation up to the filter below
+                detr = np.linalg.det(rot_cart)
+                mrot = (moments @ rot_cart.T) * detr
+                if num_mag_dims == 1:
+                    keep_op = np.allclose(mrot[:, 2], moments[perm][:, 2], atol=1e-4)
+                else:
+                    keep_op = np.allclose(mrot, moments[perm], atol=1e-4)
+                if not keep_op:
+                    continue
+            w_k = np.linalg.inv(w).T.round().astype(np.int64)
+            ops.append(
+                SymmetryOp(w=w, t=t, perm=perm, w_k=w_k, rot_cart=rot_cart)
+            )
+            seen_t.append(t)
+    return ops
+
+
+@dataclasses.dataclass
+class CrystalSymmetry:
+    ops: list[SymmetryOp]
+    lattice: np.ndarray
+
+    @staticmethod
+    def find(
+        lattice: np.ndarray,
+        positions: np.ndarray,
+        species: np.ndarray,
+        moments: np.ndarray | None = None,
+        num_mag_dims: int = 0,
+        tol: float = _TOL,
+    ) -> "CrystalSymmetry":
+        return CrystalSymmetry(
+            ops=find_symmetry(lattice, positions, species, moments, num_mag_dims, tol),
+            lattice=np.asarray(lattice, dtype=np.float64),
+        )
+
+    @property
+    def num_ops(self) -> int:
+        return len(self.ops)
+
+    @property
+    def has_inversion(self) -> bool:
+        return any(np.array_equal(op.w, -np.eye(3, dtype=np.int64)) for op in self.ops)

@@ -1,0 +1,116 @@
+"""Structure layer tests: config loading, pseudo parsing, symmetry finder,
+IBZ k-mesh (mirrors reference test_sim_ctx / spglib behavior)."""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.config import load_config
+from sirius_tpu.crystal import CrystalSymmetry, UnitCell, irreducible_kmesh
+from tests.conftest import REFERENCE_ROOT, requires_reference
+
+
+def test_config_defaults_and_load():
+    cfg = load_config({"parameters": {"pw_cutoff": 20.0, "ngridk": [2, 2, 2]}})
+    assert cfg.parameters.pw_cutoff == 20.0
+    assert cfg.parameters.smearing == "gaussian"
+    assert cfg.mixer.beta == 0.7
+    assert cfg.iterative_solver.num_steps == 20
+    d = cfg.to_dict()
+    assert d["parameters"]["ngridk"] == [2, 2, 2]
+
+
+def _fcc(a=10.26):
+    return a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+
+
+def test_symmetry_fcc_monatomic():
+    # fcc Bravais lattice, 1 atom: full Oh point group = 48 ops
+    sym = CrystalSymmetry.find(_fcc(), np.array([[0.0, 0, 0]]), np.array([0]))
+    assert sym.num_ops == 48
+    assert sym.has_inversion
+
+
+def test_symmetry_diamond():
+    # diamond: 2 atoms; 48 ops (24 symmorphic + 24 with fractional translation)
+    pos = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]])
+    sym = CrystalSymmetry.find(_fcc(), pos, np.array([0, 0]))
+    assert sym.num_ops == 48
+    # zincblende (two species): inversion lost -> 24
+    sym2 = CrystalSymmetry.find(_fcc(), pos, np.array([0, 1]))
+    assert sym2.num_ops == 24
+    assert not sym2.has_inversion
+
+
+def test_symmetry_perm_consistency():
+    pos = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]])
+    sym = CrystalSymmetry.find(_fcc(), pos, np.array([0, 0]))
+    for op in sym.ops:
+        mapped = np.mod(pos @ op.w.T + op.t, 1.0)
+        d = np.abs(mapped - pos[op.perm])
+        d = np.minimum(d, 1 - d)
+        assert d.max() < 1e-8
+        # cartesian rotation is orthogonal
+        assert np.allclose(op.rot_cart @ op.rot_cart.T, np.eye(3), atol=1e-10)
+
+
+def test_ibz_cubic_222():
+    # simple cubic, 1 atom, 2x2x2 no shift -> 4 irreducible points
+    # (0,0,0), (1/2,0,0), (1/2,1/2,0), (1/2,1/2,1/2) w/ weights 1,3,3,1 (/8)
+    sym = CrystalSymmetry.find(np.eye(3) * 7.0, np.array([[0.0, 0, 0]]), np.array([0]))
+    assert sym.num_ops == 48
+    k, w = irreducible_kmesh([2, 2, 2], [0, 0, 0], sym)
+    assert len(k) == 4
+    np.testing.assert_allclose(sorted(w), [0.125, 0.125, 0.375, 0.375])
+    np.testing.assert_allclose(np.sum(w), 1.0)
+
+
+def test_ibz_fcc_444():
+    # fcc 4x4x4 -> 8 irreducible points (standard result for Oh)
+    sym = CrystalSymmetry.find(_fcc(), np.array([[0.0, 0, 0]]), np.array([0]))
+    k, w = irreducible_kmesh([4, 4, 4], [0, 0, 0], sym)
+    assert len(k) == 8
+    np.testing.assert_allclose(np.sum(w), 1.0)
+
+
+def test_ibz_no_symmetry():
+    k, w = irreducible_kmesh([3, 2, 1], [0, 0, 0], None, use_symmetry=False,
+                             time_reversal=False)
+    assert len(k) == 6
+    np.testing.assert_allclose(w, np.full(6, 1 / 6))
+
+
+@requires_reference
+def test_load_reference_deck_test23():
+    import os
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test23")
+    cfg = load_config(os.path.join(base, "sirius.json"))
+    assert cfg.parameters.gk_cutoff == 6.0
+    uc = UnitCell.from_config(cfg.unit_cell, base)
+    assert uc.num_atoms == 1
+    assert uc.atom_types[0].zn == 1.0
+    assert uc.atom_types[0].pseudo_type == "NC"
+    assert uc.atom_types[0].num_beta == 0
+    np.testing.assert_allclose(uc.omega, 343.0)
+    # H atom in a cubic box: full Oh symmetry, 2x2x2 -> 4 k-points like SIRIUS
+    sym = CrystalSymmetry.find(uc.lattice, uc.positions, uc.type_of_atom)
+    k, w = irreducible_kmesh(cfg.parameters.ngridk, cfg.parameters.shiftk, sym)
+    assert len(k) == 4
+
+
+@requires_reference
+def test_load_reference_deck_test08_us():
+    import os
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test08")
+    cfg = load_config(os.path.join(base, "sirius.json"))
+    uc = UnitCell.from_config(cfg.unit_cell, base)
+    at = uc.atom_types[0]
+    assert at.pseudo_type == "US"
+    assert at.num_beta == 6
+    assert at.num_beta_lm == sum(2 * b.l + 1 for b in at.beta)
+    assert len(at.augmentation) > 0
+    assert at.d_ion.shape == (6, 6)
+    # diamond-structure Si
+    sym = CrystalSymmetry.find(uc.lattice, uc.positions, uc.type_of_atom)
+    assert sym.num_ops == 48
