@@ -20,9 +20,11 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     try:
         from sirius_tpu.dft.scf import run_scf_from_file
-    except ImportError:
-        print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
-        return 2
+    except ModuleNotFoundError as e:
+        if e.name in ("sirius_tpu.dft.scf", "sirius_tpu.dft"):
+            print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
+            return 2
+        raise
     return run_scf_from_file(args.input, test_against=args.test_against)
 
 

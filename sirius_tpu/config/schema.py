@@ -19,7 +19,7 @@ from typing import Any
 class ControlConfig:
     # reference input_schema.json "control" section
     processing_unit: str = "auto"
-    verbosity: int = 1
+    verbosity: int = 0
     verification: int = 0
     print_forces: bool = False
     print_stress: bool = False
@@ -28,7 +28,7 @@ class ControlConfig:
     mpi_grid_dims: list = dataclasses.field(default_factory=lambda: [1, 1])
     std_evp_solver_name: str = "auto"
     gen_evp_solver_name: str = "auto"
-    fft_mode: str = "parallel"
+    fft_mode: str = "serial"
     reduce_gvec: bool = True
     rmt_max: float = 2.2
     spglib_tolerance: float = 1e-6
@@ -102,10 +102,13 @@ class IterativeSolverConfig:
     min_tolerance: float = 1e-13
     converge_by_energy: int = 1
     min_num_res: int = 0
+    num_singular: int = -1
     init_eval_old: bool = True
     init_subspace: str = "lcao"
     extra_ortho: bool = False
     min_occupancy: float = 1e-14
+    tolerance_ratio: float = 0
+    tolerance_scale: list = dataclasses.field(default_factory=lambda: [0.1, 0.5])
 
 
 @dataclasses.dataclass
@@ -130,12 +133,14 @@ class SettingsConfig:
     fft_grid_size: list = dataclasses.field(default_factory=lambda: [0, 0, 0])
     use_coarse_fft_grid: bool = True
     pseudo_grid_cutoff: float = 10.0
-    itsol_tol_min: float = 1e-13
-    itsol_tol_ratio: float = 0
-    itsol_tol_scale: list = dataclasses.field(default_factory=lambda: [0.1, 0.5])
-    min_occupancy: float = 1e-14
-    mixer_rms_min: float = 1e-16
+    fp32_to_fp64_rms: float = 0
     auto_enu_tol: float = 0
+    sht_coverage: int = 0
+    sht_lmax: int = -1
+    simple_lapw_ri: bool = False
+    smooth_initial_mag: bool = False
+    real_occupation_matrix: bool = False
+    xc_use_lapl: bool = False
 
 
 @dataclasses.dataclass
@@ -190,6 +195,12 @@ class Config:
         out = {}
         for sec in _SECTION_TYPES:
             out[sec] = dataclasses.asdict(getattr(self, sec))
+        # merge back unknown sections/keys so round-trips are lossless
+        for sec, val in self.extra.items():
+            if sec in out and isinstance(val, dict):
+                out[sec].update(val)
+            else:
+                out[sec] = val
         return out
 
 

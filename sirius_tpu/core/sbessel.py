@@ -42,7 +42,11 @@ def spherical_jn_jax(lmax: int, x: jnp.ndarray) -> jnp.ndarray:
     # (normalizing against j0 alone cancels catastrophically near j0's zeros)
     lstart = lmax + 16
     fp = jnp.zeros_like(xs)
-    fc = jnp.full_like(xs, 1e-30)
+    # seed at the true magnitude j_lstart ~ x^l/(2l+1)!! (computed in log
+    # space, clipped to stay normal) so the unnormalized trial values reach
+    # O(1) at l=0 and the norm accumulator cannot overflow for any (lmax, x)
+    log_dfact = float(np.sum(np.log(np.arange(2 * lstart + 1, 0, -2, dtype=np.float64))))
+    fc = jnp.exp(jnp.clip(lstart * jnp.log(xs) - log_dfact, -290.0, 0.0))
     norm = (2 * lstart + 3) * fc * fc
     down = [None] * (lmax + 1)
     for l in range(lstart, -1, -1):

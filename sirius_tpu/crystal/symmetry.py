@@ -44,18 +44,35 @@ def _lattice_rotations(lattice: np.ndarray) -> np.ndarray:
     (x' = W x): basis rows transform as A' = W^T A, so the metric condition
     is W^T (A A^T) W = A A^T."""
     m = lattice @ lattice.T
-    key = hash(np.round(m / max(1.0, np.abs(m).max()), 9).tobytes())
+    scale = max(1.0, np.abs(m).max())
+    key = hash(np.round(m / scale, 9).tobytes())
     cached = _ROTATION_CACHE.get(key)
     if cached is not None:
         return cached
-    base = np.arange(5**9, dtype=np.int64)
-    digits = np.stack([(base // 5**p) % 5 - 2 for p in range(9)], axis=1)
-    cand = digits.reshape(-1, 3, 3)
-    det = np.linalg.det(cand).round().astype(np.int64)
-    cand = cand[np.abs(det) == 1]
-    mm = np.einsum("nji,jk,nkl->nil", cand, m, cand)  # W^T M W
-    keep = np.all(np.abs(mm - m[None]) < _TOL * max(1.0, np.abs(m).max()), axis=(1, 2))
-    out = cand[keep]
+    # per-column candidates first: column j of W maps basis direction e_j to
+    # an integer vector c with c^T M c = M_jj (norm preservation) — typically
+    # a few dozen candidates each — then assemble triples and check the
+    # off-diagonal metric entries and |det| = 1. Orders of magnitude cheaper
+    # than enumerating all 5^9 integer matrices.
+    base = np.arange(5**3, dtype=np.int64)
+    cols = np.stack([(base // 5**p) % 5 - 2 for p in range(3)], axis=1)  # (125,3)
+    norms = np.einsum("ni,ij,nj->n", cols, m, cols)
+    cand_j = [cols[np.abs(norms - m[j, j]) < _TOL * scale] for j in range(3)]
+    c0, c1, c2 = cand_j
+    # pairwise off-diagonal filter before the triple product
+    d01 = np.abs(np.einsum("ai,ij,bj->ab", c0, m, c1) - m[0, 1]) < _TOL * scale
+    out = []
+    for i0, i1 in zip(*np.nonzero(d01)):
+        v0, v1 = c0[i0], c1[i1]
+        ok2 = (
+            (np.abs(c2 @ (m @ v0) - m[0, 2]) < _TOL * scale)
+            & (np.abs(c2 @ (m @ v1) - m[1, 2]) < _TOL * scale)
+        )
+        for v2 in c2[ok2]:
+            w = np.stack([v0, v1, v2], axis=1)  # columns
+            if abs(round(np.linalg.det(w))) == 1:
+                out.append(w)
+    out = np.asarray(out, dtype=np.int64).reshape(-1, 3, 3)
     _ROTATION_CACHE[key] = out
     return out
 

@@ -1,0 +1,5 @@
+"""DFT physics layer: XC functionals, Poisson solver, Ewald energy, G-space
+form factors, density/potential generation, SCF driver."""
+
+from sirius_tpu.dft.xc import XCFunctional
+from sirius_tpu.dft.ewald import ewald_energy

@@ -1,0 +1,24 @@
+"""Hartree potential in reciprocal space (reference: potential/poisson.cpp:151,
+PP-PW branch; the muffin-tin pseudo-charge method arrives with the LAPW layer).
+
+V_H(G) = 4 pi rho(G) / G^2,  V_H(0) = 0 (jellium convention; the divergent
+G=0 pieces of Hartree/local/Ewald cancel in the total energy, tracked term
+by term exactly like the reference).
+E_H = Omega/2 sum_G |rho(G)|^2 4 pi / G^2.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def hartree_potential_g(rho_g: jnp.ndarray, glen2: jnp.ndarray) -> jnp.ndarray:
+    """rho(G) -> V_H(G) on the same G set (G=0 first, set to zero)."""
+    g2 = jnp.where(glen2 > 1e-12, glen2, 1.0)
+    v = 4.0 * jnp.pi * rho_g / g2
+    return jnp.where(glen2 > 1e-12, v, 0.0)
+
+
+def hartree_energy(rho_g: jnp.ndarray, vha_g: jnp.ndarray, omega: float) -> jnp.ndarray:
+    """E_H = (Omega/2) sum_G rho*(G) V_H(G) (real by construction)."""
+    return 0.5 * omega * jnp.real(jnp.sum(jnp.conj(rho_g) * vha_g))

@@ -1,0 +1,71 @@
+"""Poisson / Ewald / form-factor tests against analytic results."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.core import Gvec
+from sirius_tpu.dft.ewald import ewald_energy
+from sirius_tpu.dft.poisson import hartree_energy, hartree_potential_g
+from sirius_tpu.dft.radial_tables import vloc_form_factor
+from sirius_tpu.crystal.atom_type import AtomType
+
+
+def test_ewald_nacl_madelung():
+    # rock salt with nearest-neighbor distance d=1: E/pair = -M, M = 1.7475646
+    a = 2.0  # conventional cube, d = a/2 = 1
+    lat = a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+    gv = Gvec.build(lat, gmax=30.0)
+    pos = np.array([[0.0, 0, 0], [0.5, 0.5, 0.5]])
+    e = ewald_energy(lat, pos, np.array([1.0, -1.0]), gv.gcart, gv.millers, 30.0)
+    np.testing.assert_allclose(e, -1.747564594633, rtol=1e-9)
+
+
+def test_ewald_matches_gaussian_hartree():
+    # Ewald energy of a single unit point charge == Hartree energy of a
+    # narrow Gaussian (images negligible) minus the Gaussian self-energy.
+    a = 8.0
+    lat = np.eye(3) * a
+    gv = Gvec.build(lat, gmax=40.0)
+    sigma = 0.3
+    e_ewald = ewald_energy(lat, np.zeros((1, 3)), np.array([1.0]), gv.gcart, gv.millers, 40.0)
+    # rho(G) = e^{-sigma^2 G^2/2}/Omega for Gaussian at origin
+    rho_g = np.exp(-0.5 * sigma**2 * gv.glen2) / gv.omega
+    vha = hartree_potential_g(jnp.asarray(rho_g), jnp.asarray(gv.glen2))
+    eh = float(hartree_energy(jnp.asarray(rho_g), vha, gv.omega))
+    self_energy = 1.0 / (2.0 * np.sqrt(np.pi) * sigma)
+    # E_H omits G=0 against the uniform background; the point-charge Ewald's
+    # corresponding term is -(2 pi / Omega) sigma^2 (Gaussian spread charge)
+    background = 2.0 * np.pi * sigma**2 / gv.omega
+    np.testing.assert_allclose(e_ewald, eh - self_energy - background, atol=2e-6)
+
+
+def _erf_pseudo_atom(z=1.0):
+    """Analytic species: V_loc(r) = -z erf(r)/r (Gaussian-smeared Coulomb)."""
+    r = np.geomspace(1e-7, 12.0, 900)
+    from scipy.special import erf
+
+    return AtomType(
+        label="X", symbol="X", zn=z, pseudo_type="NC", r=r,
+        vloc=-z * erf(r) / r, beta=[], d_ion=np.zeros((0, 0)),
+        augmentation=[], atomic_wfs=[], rho_total=None, rho_core=None,
+        core_correction=False,
+    )
+
+
+def test_vloc_form_factor_analytic():
+    at = _erf_pseudo_atom(z=2.0)
+    q = np.array([0.0, 0.5, 1.5, 4.0, 9.0])
+    ff = vloc_form_factor(at, q)
+    # for V = -z erf(r)/r: ff(q) = -z e^{-q^2/4}/q^2, ff(0) = z/4
+    # (int_0^inf r erfc(r) dr = 1/4)
+    np.testing.assert_allclose(ff[0], 2.0 / 4.0, rtol=1e-8)
+    expect = -2.0 * np.exp(-q[1:] ** 2 / 4) / q[1:] ** 2
+    np.testing.assert_allclose(ff[1:], expect, atol=1e-10)
+
+
+def test_hartree_potential_g0_zero():
+    rho = jnp.array([1.0 + 0j, 0.5, 0.25])
+    g2 = jnp.array([0.0, 1.0, 4.0])
+    v = hartree_potential_g(rho, g2)
+    assert float(jnp.abs(v[0])) == 0.0
+    np.testing.assert_allclose(np.asarray(v[1:]), 4 * np.pi * np.array([0.5, 0.0625]))

@@ -62,3 +62,12 @@ def test_spherical_jn_jax_matches_scipy():
             got[:, l], spherical_jn(l, x), atol=1e-10,
             err_msg=f"l={l}",
         )
+
+
+def test_spherical_jn_jax_high_l_small_x():
+    # regression: lmax >= 19 with x just above the series cutoff used to
+    # overflow the Miller normalization and silently return zeros
+    x = np.array([2e-4, 1e-3, 5e-3, 0.05, 0.5])
+    got = np.asarray(spherical_jn_jax(20, x))
+    ref = np.stack([spherical_jn(l, x) for l in range(21)], axis=-1)
+    np.testing.assert_allclose(got, ref, atol=1e-12)

@@ -1,0 +1,109 @@
+"""XC functional tests: known analytic values, autodiff-potential consistency
+with finite differences, spin-symmetry consistency (mirrors reference
+test_pppw_xc and the libxc reference values)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sirius_tpu.dft.xc import XCFunctional
+
+
+def test_lda_x_known_value():
+    xc = XCFunctional(["XC_LDA_X"])
+    rho = jnp.array([1.0])
+    out = xc.evaluate(rho)
+    eps = float(out["e"][0])  # energy per volume at rho=1 == eps per particle
+    np.testing.assert_allclose(eps, -(3 / 4) * (3 / np.pi) ** (1 / 3), rtol=1e-12)
+    # v_x = (4/3) eps_x for LDA exchange
+    np.testing.assert_allclose(float(out["v"][0]), 4 / 3 * eps, rtol=1e-12)
+
+
+def test_lda_c_pz_known_value():
+    # PZ at rs=2 (low-density branch): eps_c = gamma/(1+b1*sqrt(2)+b2*2)
+    rs = 2.0
+    rho = 3 / (4 * np.pi * rs**3)
+    xc = XCFunctional(["XC_LDA_C_PZ"])
+    out = xc.evaluate(jnp.array([rho]))
+    expect = -0.1423 / (1 + 1.0529 * np.sqrt(2.0) + 0.3334 * 2.0)
+    np.testing.assert_allclose(float(out["e"][0]) / rho, expect, rtol=1e-10)
+
+
+def test_lda_c_pw_known_value():
+    # PW92 eps_c(rs=2, zeta=0) = -0.044757 Ha (published)
+    rs = 2.0
+    rho = 3 / (4 * np.pi * rs**3)
+    xc = XCFunctional(["XC_LDA_C_PW"])
+    out = xc.evaluate(jnp.array([rho]))
+    np.testing.assert_allclose(float(out["e"][0]) / rho, -0.04476, rtol=1e-3)
+
+
+@pytest.mark.parametrize("names", [["XC_LDA_X", "XC_LDA_C_PZ"], ["XC_LDA_C_PW"]])
+def test_vxc_matches_finite_difference(names):
+    xc = XCFunctional(names)
+    rho = jnp.array([0.02, 0.3, 1.1, 4.0])
+    out = xc.evaluate(rho)
+    h = 1e-6
+    for i in range(len(rho)):
+        ep = float(xc.evaluate(rho.at[i].add(h))["e"].sum())
+        em = float(xc.evaluate(rho.at[i].add(-h))["e"].sum())
+        np.testing.assert_allclose(float(out["v"][i]), (ep - em) / (2 * h), rtol=1e-5)
+
+
+def test_spin_consistency_lda():
+    xc = XCFunctional(["XC_LDA_X", "XC_LDA_C_PZ"])
+    rho = jnp.array([0.2, 0.9])
+    unpol = xc.evaluate(rho)
+    pol = xc.evaluate_polarized(rho / 2, rho / 2)
+    np.testing.assert_allclose(np.asarray(pol["e"]), np.asarray(unpol["e"]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(pol["v_up"]), np.asarray(unpol["v"]), rtol=1e-12)
+
+
+def test_fully_polarized_exchange():
+    # E_x[n,0] = 2^{1/3} E_x[n/2,n/2]
+    xc = XCFunctional(["XC_LDA_X"])
+    n = jnp.array([0.7])
+    ep = xc.evaluate_polarized(n, jnp.array([1e-30]))
+    eu = xc.evaluate(n)
+    np.testing.assert_allclose(
+        float(ep["e"][0]), 2 ** (1 / 3) * float(eu["e"][0]), rtol=1e-9
+    )
+
+
+def test_pbe_reduces_to_lda_at_zero_gradient():
+    xcp = XCFunctional(["XC_GGA_X_PBE"])
+    xcl = XCFunctional(["XC_LDA_X"])
+    rho = jnp.array([0.5, 1.5])
+    sig = jnp.zeros(2)
+    np.testing.assert_allclose(
+        np.asarray(xcp.evaluate(rho, sig)["e"]),
+        np.asarray(xcl.evaluate(rho)["e"]),
+        rtol=1e-10,
+    )
+
+
+def test_pbe_enhancement_factor():
+    # F_x(s) = 1 + kappa - kappa/(1 + mu s^2/kappa); test at s=1
+    kappa, mu = 0.804, 0.2195149727645171
+    rho = 1.0
+    kf = (3 * np.pi**2 * rho) ** (1 / 3)
+    s = 1.0
+    sigma = (2 * kf * rho * s) ** 2
+    xcp = XCFunctional(["XC_GGA_X_PBE"])
+    xcl = XCFunctional(["XC_LDA_X"])
+    fx = float(xcp.evaluate(jnp.array([rho]), jnp.array([sigma]))["e"][0]) / float(
+        xcl.evaluate(jnp.array([rho]))["e"][0]
+    )
+    np.testing.assert_allclose(fx, 1 + kappa - kappa / (1 + mu / kappa), rtol=1e-8)
+
+
+def test_pbe_c_vsigma_finite_difference():
+    xc = XCFunctional(["XC_GGA_C_PBE"])
+    rho = jnp.array([0.8])
+    sig = jnp.array([0.3])
+    out = xc.evaluate(rho, sig)
+    h = 1e-6
+    ep = float(xc.evaluate(rho, sig + h)["e"][0])
+    em = float(xc.evaluate(rho, sig - h)["e"][0])
+    np.testing.assert_allclose(float(out["vsigma"][0]), (ep - em) / (2 * h), rtol=1e-5)
