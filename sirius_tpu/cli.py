@@ -17,7 +17,37 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("input", nargs="?", default="sirius.json", help="JSON input file")
     p.add_argument("--test_against", help="reference output JSON to compare against")
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu", "axon"],
+        help="JAX platform; 'cpu' runs the f64 verification path. Note: the "
+        "JAX_PLATFORMS env var is unreliable when a sitecustomize pre-imports "
+        "jax, so this flag sets jax.config explicitly. Default: cpu when the "
+        "deck requests processing_unit=cpu, else the jax default.",
+    )
     args = p.parse_args(argv)
+
+    import json
+    import os
+
+    # fail fast on a bad input path, before any (slow) jax backend init
+    if not os.path.isfile(args.input):
+        print(f"sirius-scf: input file not found: {args.input}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    platform = args.platform
+    if platform is None:
+        try:
+            with open(args.input) as f:
+                if json.load(f).get("control", {}).get("processing_unit") == "cpu":
+                    platform = "cpu"
+        except (OSError, json.JSONDecodeError):
+            pass
+    if platform:
+        jax.config.update("jax_platforms", "axon" if platform == "tpu" else platform)
     try:
         from sirius_tpu.dft.scf import run_scf_from_file
     except ModuleNotFoundError as e:

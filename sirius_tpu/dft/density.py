@@ -1,0 +1,112 @@
+"""Charge density: initial guess and generation from wave functions.
+
+Reference: src/density/density.cpp (initial_density :137, generate :1105,
+add_k_point_contribution_rg :700-760). The reference loops bands with
+per-band FFTs and accumulates |psi(r)|^2 with OMP/CUDA kernels
+(density_rg.cu); here the whole band block is one batched FFT and the
+occupation-weighted reduction is a single einsum, jitted per k-point.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.context import SimulationContext
+from sirius_tpu.core.fftgrid import g_to_r, r_to_g
+
+
+def initial_density_g(ctx: SimulationContext) -> np.ndarray:
+    """Superposition of free-atom densities, normalized to the electron
+    count (reference density.cpp:137 initial_density_pseudo)."""
+    rho_g = ctx.rho_atomic_g.copy()
+    nel = ctx.unit_cell.num_valence_electrons
+    n0 = rho_g[0].real * ctx.unit_cell.omega
+    if abs(n0) < 1e-12:
+        raise ValueError("free-atom density missing in species files")
+    rho_g *= nel / n0
+    return rho_g
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def _accumulate_k(
+    psi: jax.Array,  # [nspin, nb, ngk]
+    occ_w: jax.Array,  # [nspin, nb] occupation * k-weight
+    fft_index: jax.Array,
+    dims: tuple[int, int, int],
+) -> jax.Array:
+    """sum_{s,b} occ_w[s,b] |psi_sb(r)|^2 on the coarse box (one batched FFT)."""
+    n = dims[0] * dims[1] * dims[2]
+    batch = psi.shape[:-1]
+    box = jnp.zeros(batch + (n,), dtype=psi.dtype).at[..., fft_index].add(psi)
+    fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1)) * n
+    return jnp.einsum("sb,sbxyz->xyz", occ_w, jnp.abs(fr) ** 2)
+
+
+def generate_density_g(
+    ctx: SimulationContext,
+    psi_all: jnp.ndarray,  # [nk, nspin, nb, ngk_max]
+    occ: np.ndarray,  # [nk, nspin, nb]
+    symmetrize: bool = True,
+) -> np.ndarray:
+    """rho(G) on the fine set from occupied wave functions.
+
+    psi are S-normalized PW coefficients; |psi(r)|^2 accumulated on the
+    coarse box, divided by Omega, transformed to coarse G, mapped to fine G.
+    Symmetrization over the full group happens on G coefficients.
+    """
+    dims = ctx.fft_coarse.dims
+    nk = ctx.gkvec.num_kpoints
+    acc = jnp.zeros(dims)
+    for ik in range(nk):
+        ow = jnp.asarray(occ[ik] * ctx.kweights[ik])
+        acc = acc + _accumulate_k(
+            psi_all[ik], ow, jnp.asarray(ctx.gkvec.fft_index[ik]), dims
+        )
+    rho_r_coarse = np.asarray(acc) / ctx.unit_cell.omega
+    rho_g_coarse = np.asarray(
+        r_to_g(jnp.asarray(rho_r_coarse, dtype=jnp.complex128),
+               jnp.asarray(ctx.gvec_coarse.fft_index), dims)
+    )
+    rho_g = np.zeros(ctx.gvec.num_gvec, dtype=np.complex128)
+    rho_g[ctx.coarse_to_fine] = rho_g_coarse
+    if symmetrize and ctx.symmetry is not None and ctx.symmetry.num_ops > 1:
+        rho_g = symmetrize_pw(ctx, rho_g)
+    return rho_g
+
+
+def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
+    """Symmetrize PW coefficients over the space group.
+
+    f'(r) = (1/N) sum_S f(S^{-1} r) with S: x -> W x + t gives, for
+    g' = (W^{-1})^T g = w_k g:
+        f'(g') += f(g) e^{-2 pi i g'. t} / N
+    (reference symmetrize_pw_function.hpp via Gvec_shells remap). The sphere
+    is rotation-invariant so every image lands inside the set; rotation
+    tables per op are cached on the context's gvec."""
+    sym = ctx.symmetry
+    gv = ctx.gvec
+    cache = getattr(ctx, "_sym_rot_cache", None)
+    if cache is None:
+        lut = {tuple(m): i for i, m in enumerate(gv.millers)}
+        cache = []
+        for op in sym.ops:
+            gm = gv.millers @ op.w_k.T  # rows g' = w_k g
+            idx = np.asarray([lut[tuple(m)] for m in gm], dtype=np.int64)
+            phase = np.exp(-2j * np.pi * (gm @ op.t))
+            cache.append((idx, phase))
+        ctx._sym_rot_cache = cache
+    out = np.zeros_like(f_g)
+    for idx, phase in cache:
+        np.add.at(out, idx, f_g * phase)
+    return out / sym.num_ops
+
+
+def rho_real_space(ctx: SimulationContext, rho_g: np.ndarray) -> np.ndarray:
+    """rho(r) on the fine box."""
+    return np.asarray(
+        g_to_r(jnp.asarray(rho_g), jnp.asarray(ctx.gvec.fft_index), ctx.gvec.fft.dims)
+    ).real

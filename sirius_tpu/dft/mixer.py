@@ -1,0 +1,66 @@
+"""Density mixers (reference: src/mixer/ — Linear, Anderson, Broyden2 over a
+tuple of function spaces with configurable inner products, mixer.hpp:37-63).
+
+Round-1 scope: the mixed vector is rho(G) on the fine set (complex), with
+either the plain l2 inner product or the Hartree-weighted G-space metric
+(4 pi / G^2, reference mixer_functions.cpp use_hartree) which preconditions
+long-wavelength charge sloshing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Mixer:
+    KNOWN = ("linear", "anderson", "anderson_stable", "broyden2")
+
+    def __init__(self, cfg, glen2: np.ndarray | None = None):
+        if cfg.type not in self.KNOWN:
+            raise ValueError(
+                f"unknown mixer type '{cfg.type}' (supported: {self.KNOWN})"
+            )
+        self.beta = cfg.beta
+        self.max_history = cfg.max_history
+        self.kind = cfg.type
+        self.weight = None
+        if cfg.use_hartree and glen2 is not None:
+            g2 = np.where(glen2 > 1e-12, glen2, np.inf)
+            self.weight = 4.0 * np.pi / g2
+        self._x: list[np.ndarray] = []  # input history
+        self._f: list[np.ndarray] = []  # residual history f = x_out - x_in
+
+    def _inner(self, a: np.ndarray, b: np.ndarray) -> float:
+        w = self.weight if self.weight is not None else 1.0
+        return float(np.real(np.sum(w * np.conj(a) * b)))
+
+    def rms(self, x_in: np.ndarray, x_out: np.ndarray) -> float:
+        d = x_out - x_in
+        return float(np.sqrt(max(self._inner(d, d), 0.0) / d.size))
+
+    def mix(self, x_in: np.ndarray, x_out: np.ndarray) -> np.ndarray:
+        f = x_out - x_in
+        if self.kind == "linear" or not self._x:
+            nxt = x_in + self.beta * f
+        elif self.kind in ("anderson", "anderson_stable", "broyden2"):
+            # Anderson acceleration (type-II): minimize ||f - sum g_j df_j||
+            m = len(self._x)
+            dfs = [f - self._f[j] for j in range(m)]
+            dxs = [x_in - self._x[j] for j in range(m)]
+            a = np.array([[self._inner(dfs[i], dfs[j]) for j in range(m)] for i in range(m)])
+            b = np.array([self._inner(dfs[i], f) for i in range(m)])
+            try:
+                g = np.linalg.lstsq(a + 1e-12 * np.trace(a) / max(m, 1) * np.eye(m), b, rcond=None)[0]
+            except np.linalg.LinAlgError:
+                g = np.zeros(m)
+            x_opt = x_in - sum(gi * dxi for gi, dxi in zip(g, dxs))
+            f_opt = f - sum(gi * dfi for gi, dfi in zip(g, dfs))
+            nxt = x_opt + self.beta * f_opt
+        else:
+            raise ValueError(f"unknown mixer type '{self.kind}'")
+        self._x.append(x_in.copy())
+        self._f.append(f.copy())
+        if len(self._x) > self.max_history:
+            self._x.pop(0)
+            self._f.pop(0)
+        return nxt

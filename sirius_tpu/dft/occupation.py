@@ -1,0 +1,94 @@
+"""Band occupations: smearing functions and Fermi-level search.
+
+Reference: src/dft/smearing.cpp (definitions copied exactly, argument
+x = E_F - e) and K_point_set::find_band_occupancies
+(k_point_set.cpp:171-378, Newton with bisection fallback). Here the search
+is a fixed-count bisection, fully vectorized over (k, spin, band) and
+jit-able inside the SCF step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+SQRT2 = 1.4142135623730951
+SQRT_PI = 1.7724538509055159
+
+
+def occupancy(kind: str, x: jnp.ndarray, w: float) -> jnp.ndarray:
+    """f(x) in [0, 1] with x = mu - eps (reference smearing.cpp)."""
+    t = x / w
+    if kind == "gaussian":
+        return 0.5 * (1.0 + jax.scipy.special.erf(t))
+    if kind == "fermi_dirac":
+        return 1.0 - 1.0 / (1.0 + jnp.exp(jnp.clip(t, -200, 200)))
+    if kind == "cold":
+        y = t - 1.0 / SQRT2
+        return 0.5 * (1.0 + jax.scipy.special.erf(y)) + jnp.exp(
+            -jnp.minimum(y * y, 200.0)
+        ) / jnp.sqrt(2.0 * jnp.pi)
+    if kind == "methfessel_paxton":
+        # order-1 MP: f_gauss + A1 H1(t) e^{-t^2}, A1 = -1/(4 sqrt(pi))
+        e = jnp.exp(-jnp.minimum(t * t, 200.0))
+        return 0.5 * (1.0 + jax.scipy.special.erf(t)) - (2.0 * t) * e / (4.0 * SQRT_PI)
+    raise ValueError(f"unknown smearing '{kind}'")
+
+
+def entropy_term(kind: str, x: jnp.ndarray, w: float) -> jnp.ndarray:
+    """Per-state entropy contribution (reference conventions; sums to the
+    'entropy_sum' output; free energy = E_tot + entropy_sum)."""
+    t = x / w
+    if kind == "gaussian":
+        return -jnp.exp(-jnp.minimum(t * t, 200.0)) * w / (2.0 * SQRT_PI)
+    if kind == "fermi_dirac":
+        f = 1.0 / (1.0 + jnp.exp(jnp.clip(t, -200, 200)))  # = 1 - occupancy
+        fl = jnp.clip(f, 1e-30, 1.0)
+        gl = jnp.clip(1.0 - f, 1e-30, 1.0)
+        return w * (f * jnp.log(fl) + (1.0 - f) * jnp.log(gl))
+    if kind == "cold":
+        y = t - 1.0 / SQRT2
+        return -jnp.exp(-jnp.minimum(y * y, 200.0)) * (w - SQRT2 * x) / (2.0 * SQRT_PI)
+    if kind == "methfessel_paxton":
+        # order-1 MP entropy: 0.5 A1 H2(t) e^{-t^2} with H2 = 4t^2-2
+        e = jnp.exp(-jnp.minimum(t * t, 200.0))
+        return w * 0.5 * (-1.0 / (4.0 * SQRT_PI)) * (4.0 * t * t - 2.0) * e
+    raise ValueError(f"unknown smearing '{kind}'")
+
+
+@partial(jax.jit, static_argnames=("kind", "num_iter"))
+def find_fermi(
+    evals: jnp.ndarray,  # [nk, nspin, nb]
+    kweights: jnp.ndarray,  # [nk]
+    num_electrons: float,
+    width: float,
+    kind: str = "gaussian",
+    max_occupancy: float = 2.0,
+    num_iter: int = 80,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Bisection for mu such that sum_k w_k sum_{s,b} max_occ * f(mu-e) = N.
+
+    Returns (mu, occupations [nk, nspin, nb], entropy_sum)."""
+
+    def count(mu):
+        f = occupancy(kind, mu - evals, width)
+        return jnp.sum(kweights[:, None, None] * f) * max_occupancy
+
+    lo = jnp.min(evals) - 10.0
+    hi = jnp.max(evals) + 10.0
+
+    def body(_, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        too_low = count(mid) < num_electrons
+        return jnp.where(too_low, mid, lo), jnp.where(too_low, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, num_iter, body, (lo, hi))
+    mu = 0.5 * (lo + hi)
+    occ = max_occupancy * occupancy(kind, mu - evals, width)
+    ent = max_occupancy * jnp.sum(
+        kweights[:, None, None] * entropy_term(kind, mu - evals, width)
+    )
+    return mu, occ, ent

@@ -1,0 +1,119 @@
+"""Effective potential generation (reference: src/potential/potential.cpp:236
+Potential::generate, PP-PW branch): Poisson -> XC -> V_eff assembly, plus all
+the energy integrals the reference reports (energy.hpp:280 energy_dict).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.context import SimulationContext
+from sirius_tpu.core.fftgrid import g_to_r, r_to_g
+from sirius_tpu.dft.density import symmetrize_pw
+from sirius_tpu.dft.poisson import hartree_potential_g
+from sirius_tpu.dft.xc import XCFunctional
+
+
+@dataclasses.dataclass
+class PotentialResult:
+    veff_g: np.ndarray  # fine G
+    veff_r_coarse: np.ndarray  # coarse box, for H application
+    vha_g: np.ndarray
+    vxc_r: np.ndarray  # fine box
+    exc_r: np.ndarray  # fine box (energy density)
+    energies: dict
+
+
+def _inner_rr(ctx: SimulationContext, f_r: np.ndarray, g_r: np.ndarray) -> float:
+    """Real-space integral over the cell: (Omega/N) sum_r f g."""
+    n = f_r.size
+    return float(np.sum(f_r * g_r) * ctx.unit_cell.omega / n)
+
+
+def generate_potential(
+    ctx: SimulationContext,
+    rho_g: np.ndarray,
+    xc: XCFunctional,
+) -> PotentialResult:
+    gv = ctx.gvec
+    dims = gv.fft.dims
+    fft_index = jnp.asarray(gv.fft_index)
+    omega = ctx.unit_cell.omega
+
+    # Hartree
+    vha_g = np.asarray(hartree_potential_g(jnp.asarray(rho_g), jnp.asarray(gv.glen2)))
+    # real-space densities
+    rho_r = np.asarray(g_to_r(jnp.asarray(rho_g), fft_index, dims)).real
+    rho_core_r = (
+        np.asarray(g_to_r(jnp.asarray(ctx.rho_core_g), fft_index, dims)).real
+        if np.any(ctx.rho_core_g)
+        else np.zeros(dims)
+    )
+    rho_xc = np.maximum(rho_r + rho_core_r, 0.0)
+
+    # XC (LDA for now; GGA needs gradients — computed in G space)
+    if xc.is_gga:
+        grad = [
+            np.asarray(
+                g_to_r(jnp.asarray(1j * gv.gcart[:, i] * (rho_g + ctx.rho_core_g)), fft_index, dims)
+            ).real
+            for i in range(3)
+        ]
+        sigma = grad[0] ** 2 + grad[1] ** 2 + grad[2] ** 2
+        out = xc.evaluate(jnp.asarray(rho_xc.ravel()), jnp.asarray(sigma.ravel()))
+        vxc_r = np.asarray(out["v"]).reshape(dims)
+        exc_r = np.asarray(out["e"]).reshape(dims) / np.maximum(rho_xc, 1e-25)
+        # gradient correction: V -= div(2 vsigma grad rho)
+        vs = np.asarray(out["vsigma"]).reshape(dims)
+        div = np.zeros(dims)
+        for i in range(3):
+            t_g = np.asarray(
+                r_to_g(jnp.asarray((2.0 * vs * grad[i]).astype(np.complex128)), fft_index, dims)
+            )
+            div += np.asarray(
+                g_to_r(jnp.asarray(1j * gv.gcart[:, i] * t_g), fft_index, dims)
+            ).real
+        vxc_r = vxc_r - div
+    else:
+        out = xc.evaluate(jnp.asarray(rho_xc.ravel()))
+        vxc_r = np.asarray(out["v"]).reshape(dims)
+        exc_r = np.asarray(out["e"]).reshape(dims) / np.maximum(rho_xc, 1e-25)
+
+    # assemble V_eff(G) = V_loc(G) + V_H(G) + V_xc(G)
+    vxc_g = np.asarray(r_to_g(jnp.asarray(vxc_r.astype(np.complex128)), fft_index, dims))
+    veff_g = ctx.vloc_g + vha_g + vxc_g
+    if ctx.symmetry is not None and ctx.symmetry.num_ops > 1:
+        veff_g = symmetrize_pw(ctx, veff_g)
+
+    # map to coarse box for the local operator
+    veff_g_coarse = veff_g[ctx.coarse_to_fine]
+    veff_r_coarse = np.asarray(
+        g_to_r(
+            jnp.asarray(veff_g_coarse),
+            jnp.asarray(ctx.gvec_coarse.fft_index),
+            ctx.fft_coarse.dims,
+        )
+    ).real
+
+    # energy integrals (reference names; all with valence rho except exc)
+    vloc_r = np.asarray(g_to_r(jnp.asarray(ctx.vloc_g), fft_index, dims)).real
+    vha_r = np.asarray(g_to_r(jnp.asarray(vha_g), fft_index, dims)).real
+    veff_r = np.asarray(g_to_r(jnp.asarray(veff_g), fft_index, dims)).real
+    energies = {
+        "vha": _inner_rr(ctx, rho_r, vha_r),
+        "vxc": _inner_rr(ctx, rho_r, vxc_r),
+        "vloc": _inner_rr(ctx, rho_r, vloc_r),
+        "veff": _inner_rr(ctx, rho_r, veff_r),
+        "exc": _inner_rr(ctx, rho_r + rho_core_r, exc_r),
+    }
+    return PotentialResult(
+        veff_g=veff_g,
+        veff_r_coarse=veff_r_coarse,
+        vha_g=vha_g,
+        vxc_r=vxc_r,
+        exc_r=exc_r,
+        energies=energies,
+    )

@@ -1,0 +1,235 @@
+"""SCF ground-state driver (reference: src/dft/dft_ground_state.cpp find
+:178-427 and the sirius.scf mini-app output JSON).
+
+Orchestration is host python; the hot pieces (per-k solver, density
+accumulation, potential algebra) are jitted. The per-k eigensolve warm-starts
+from the previous iteration's wave functions.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.config.schema import Config, load_config
+from sirius_tpu.context import SimulationContext
+from sirius_tpu.dft.density import generate_density_g, initial_density_g, rho_real_space
+from sirius_tpu.dft.mixer import Mixer
+from sirius_tpu.dft.occupation import find_fermi
+from sirius_tpu.dft.potential import generate_potential
+from sirius_tpu.dft.xc import XCFunctional
+from sirius_tpu.ops.atomic import atomic_orbitals
+from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
+from sirius_tpu.solvers.davidson import davidson
+
+
+def _h_o_diag(ctx: SimulationContext, ik: int, v0: float):
+    """Diagonals of H and S for the preconditioner (reference
+    get_h_o_diag_pw)."""
+    ekin = ctx.gkvec.kinetic()[ik]
+    h = ekin + v0
+    o = np.ones_like(h)
+    if ctx.beta.num_beta_total:
+        b = ctx.beta.beta_gk[ik]
+        h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), ctx.beta.dion, b))
+        if ctx.beta.qmat is not None:
+            o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), ctx.beta.qmat, b))
+    return np.where(ctx.gkvec.mask[ik] > 0, h, 1e4), np.where(
+        ctx.gkvec.mask[ik] > 0, o, 1.0
+    )
+
+
+def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
+    """LCAO + random-fill initial wave functions [nk, nspin, nb, ngk]."""
+    nk = ctx.gkvec.num_kpoints
+    nb = ctx.num_bands
+    ngk = ctx.gkvec.ngk_max
+    ao = atomic_orbitals(ctx.unit_cell, ctx.gkvec, ctx.cfg.parameters.gk_cutoff + 1e-9)
+    rng = np.random.default_rng(42)
+    psi = np.zeros((nk, ctx.num_spins, nb, ngk), dtype=np.complex128)
+    for ik in range(nk):
+        nao = ao.shape[1]
+        base = np.zeros((nb, ngk), dtype=np.complex128)
+        n0 = min(nao, nb)
+        if n0:
+            base[:n0] = ao[ik, :n0]
+        if nb > n0:
+            r = rng.standard_normal((nb - n0, ngk)) + 1j * rng.standard_normal((nb - n0, ngk))
+            # damp high-G components so random vectors are smooth-ish
+            damp = 1.0 / (1.0 + ctx.gkvec.kinetic()[ik])
+            base[n0:] = r * damp
+        base *= ctx.gkvec.mask[ik]
+        for ispn in range(ctx.num_spins):
+            psi[ik, ispn] = base
+    return jnp.asarray(psi)
+
+
+def run_scf(cfg: Config, base_dir: str = ".") -> dict:
+    t0 = time.time()
+    p = cfg.parameters
+    ctx = SimulationContext.create(cfg, base_dir)
+    xc = XCFunctional(p.xc_functionals)
+    nk, ns, nb = ctx.gkvec.num_kpoints, ctx.num_spins, ctx.num_bands
+    nel = ctx.unit_cell.num_valence_electrons - p.extra_charge
+
+    if nb * ctx.max_occupancy * ctx.num_spins < nel - 1e-12:
+        raise ValueError(
+            f"num_bands={nb} cannot hold {nel} electrons "
+            f"(max {nb * ctx.max_occupancy * ctx.num_spins})"
+        )
+    if ctx.beta.qmat is not None:
+        # S-normalization without the augmentation charge in the density
+        # would silently violate charge conservation
+        raise NotImplementedError(
+            "ultrasoft/PAW augmentation charge is not implemented yet; "
+            "only norm-conserving species are supported in this revision"
+        )
+    if ctx.num_mag_dims != 0:
+        raise NotImplementedError("magnetism lands after the ultrasoft layer")
+
+    rho_g = initial_density_g(ctx)
+    pot = generate_potential(ctx, rho_g, xc)
+    psi = _initial_subspace(ctx)
+    mixer = Mixer(cfg.mixer, ctx.gvec.glen2)
+
+    evals = np.zeros((nk, ns, nb))
+    mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
+    etot_history, rms_history = [], []
+    e_prev, converged, rms = None, False, 0.0
+    num_iter_done = 0
+    itsol = cfg.iterative_solver
+
+    for it in range(p.num_dft_iter):
+        # --- band solve per k (warm start) ---
+        new_psi = []
+        for ik in range(nk):
+            params = make_hk_params(ctx, ik, pot.veff_r_coarse)
+            v0 = float(np.real(pot.veff_g[0]))
+            h_diag, o_diag = _h_o_diag(ctx, ik, v0)
+            per_spin = []
+            for ispn in range(ns):
+                ev, x, rn = davidson(
+                    apply_h_s,
+                    params,
+                    psi[ik, ispn],
+                    jnp.asarray(h_diag),
+                    jnp.asarray(o_diag),
+                    jnp.asarray(ctx.gkvec.mask[ik]),
+                    num_steps=itsol.num_steps,
+                    res_tol=itsol.residual_tolerance,
+                )
+                evals[ik, ispn] = np.asarray(ev)
+                per_spin.append(x)
+            new_psi.append(jnp.stack(per_spin))
+        psi = jnp.stack(new_psi)
+
+        # --- occupations ---
+        mu, occ, entropy_sum = find_fermi(
+            jnp.asarray(evals),
+            jnp.asarray(ctx.kweights),
+            nel,
+            p.smearing_width,
+            kind=p.smearing,
+            max_occupancy=ctx.max_occupancy,
+        )
+        occ_np = np.asarray(occ)
+
+        # --- density ---
+        rho_new = generate_density_g(ctx, psi, occ_np, symmetrize=p.use_symmetry)
+        rms = mixer.rms(rho_g, rho_new)
+        rho_mixed = mixer.mix(rho_g, rho_new)
+        rho_g = rho_mixed
+
+        # --- potential + energies ---
+        pot = generate_potential(ctx, rho_g, xc)
+        eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
+        e = pot.energies
+        e_total = (
+            eval_sum - e["vxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+        )
+        etot_history.append(e_total)
+        rms_history.append(rms)
+        num_iter_done = it + 1
+
+        de = abs(e_total - e_prev) if e_prev is not None else np.inf
+        e_prev = e_total
+        if de < p.energy_tol and rms < p.density_tol:
+            converged = True
+            break
+
+    # --- final report ---
+    occ_np = np.asarray(occ)
+    band_gap = _band_gap(evals, occ_np, ctx)
+    rho_r = rho_real_space(ctx, rho_g)
+    e = pot.energies
+    eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
+    e_total = eval_sum - e["vxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+    result = {
+        "converged": converged,
+        "num_scf_iterations": num_iter_done,
+        "efermi": float(mu),
+        "band_gap": band_gap,
+        "rho_min": float(rho_r.min()),
+        "etot_history": etot_history,
+        "rms_history": rms_history,
+        "scf_time": time.time() - t0,
+        "energy": {
+            "total": e_total,
+            "free": e_total + float(entropy_sum),
+            "eval_sum": eval_sum,
+            "kin": eval_sum - e["veff"],
+            "veff": e["veff"],
+            "vha": e["vha"],
+            "vxc": e["vxc"],
+            "vloc": e["vloc"],
+            "exc": e["exc"],
+            "bxc": 0.0,
+            "ewald": ctx.e_ewald,
+            "entropy_sum": float(entropy_sum),
+            "scf_correction": 0.0,
+        },
+        "band_energies": evals.tolist(),
+        "band_occupancies": occ_np.tolist(),
+    }
+    return result
+
+
+def _band_gap(evals: np.ndarray, occ: np.ndarray, ctx: SimulationContext) -> float:
+    tol = 1e-6 * ctx.max_occupancy
+    occupied = evals[occ > ctx.max_occupancy - 1e-4]
+    empty = evals[occ < tol]
+    if len(occupied) == 0 or len(empty) == 0:
+        return 0.0
+    gap = float(empty.min() - occupied.max())
+    # metallic if partial occupancies straddle
+    partial = (occ > tol) & (occ < ctx.max_occupancy - 1e-4)
+    if np.any(partial) and gap < 1e-8:
+        return 0.0
+    return max(gap, 0.0)
+
+
+def run_scf_from_file(path: str, test_against: str | None = None) -> int:
+    import os
+
+    cfg = load_config(path)
+    base_dir = os.path.dirname(os.path.abspath(path))
+    result = run_scf(cfg, base_dir)
+    out = {"ground_state": result}
+    print(json.dumps({"energy": result["energy"], "efermi": result["efermi"],
+                      "converged": result["converged"],
+                      "num_scf_iterations": result["num_scf_iterations"]}, indent=2))
+    with open("output.json", "w") as f:
+        json.dump(out, f, indent=2)
+    if test_against:
+        with open(test_against) as f:
+            ref = json.load(f)["ground_state"]
+        de = abs(ref["energy"]["total"] - result["energy"]["total"])
+        print(f"|dE_total| vs reference: {de:.3e}")
+        if de > 1e-5:
+            print("TEST FAILED")
+            return 1
+        print("TEST PASSED")
+    return 0

@@ -1,0 +1,8 @@
+"""Hamiltonian operators: batched local (FFT) part, non-local beta
+projectors, overlap. The TPU replacement for the reference's
+src/hamiltonian/local_operator.* and non_local_operator.* + the CUDA kernels
+(local_operator.cu, create_beta_gk.cu): per-band loops become one batched
+FFT + MXU einsums."""
+
+from sirius_tpu.ops.local import apply_local
+from sirius_tpu.ops.beta import BetaProjectors

@@ -1,0 +1,70 @@
+"""Per-k Hamiltonian application as a pure function over a parameter pytree.
+
+Keeping all per-k data (potential box, kinetic energies, projector tables)
+in one NamedTuple pytree — rather than captured in python closures — means
+the jitted solver compiles ONCE for the whole k-set and every SCF iteration
+(closures would retrace per call; measured 20x+ end-to-end difference).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class HkParams(NamedTuple):
+    """Everything needed to apply H and S at one k-point (pytree)."""
+
+    veff_r: jax.Array  # [n1,n2,n3] effective potential on coarse box
+    ekin: jax.Array  # [ngk]
+    mask: jax.Array  # [ngk]
+    fft_index: jax.Array  # [ngk] int32
+    beta: jax.Array  # [nbeta, ngk] (nbeta may be 0)
+    dion: jax.Array  # [nbeta, nbeta]
+    qmat: jax.Array  # [nbeta, nbeta]; all-zero if norm-conserving
+
+
+def make_hk_params(ctx, ik: int, veff_r_coarse: np.ndarray) -> HkParams:
+    nbeta = ctx.beta.num_beta_total
+    beta = ctx.beta.beta_gk[ik] if nbeta else np.zeros((0, ctx.gkvec.ngk_max))
+    qmat = (
+        ctx.beta.qmat
+        if ctx.beta.qmat is not None
+        else np.zeros((nbeta, nbeta))
+    )
+    return HkParams(
+        veff_r=jnp.asarray(veff_r_coarse),
+        ekin=jnp.asarray(ctx.gkvec.kinetic()[ik]),
+        mask=jnp.asarray(ctx.gkvec.mask[ik]),
+        fft_index=jnp.asarray(ctx.gkvec.fft_index[ik]),
+        beta=jnp.asarray(beta, dtype=jnp.complex128),
+        dion=jnp.asarray(ctx.beta.dion),
+        qmat=jnp.asarray(qmat),
+    )
+
+
+def apply_h_s(params: HkParams, psi: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(H psi, S psi) for a band block psi [nb, ngk]."""
+    dims = params.veff_r.shape
+    n = dims[0] * dims[1] * dims[2]
+    psi = psi * params.mask
+    batch = psi.shape[:-1]
+    box = jnp.zeros(batch + (n,), dtype=psi.dtype).at[..., params.fft_index].add(psi)
+    fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1))
+    vpsi = (
+        jnp.fft.fftn(fr * params.veff_r, axes=(-3, -2, -1))
+        .reshape(batch + (n,))[..., params.fft_index]
+    )
+    ekin = jnp.where(params.mask > 0, params.ekin, 0.0)
+    hpsi = ekin * psi + vpsi
+    spsi = psi
+    if params.beta.shape[0]:
+        bp = jnp.einsum("xg,bg->bx", jnp.conj(params.beta), psi)
+        hpsi = hpsi + jnp.einsum("bx,xy,yg->bg", bp, params.dion, params.beta)
+        # qmat is all-zero for norm-conserving species; the extra einsum is
+        # negligible next to the FFTs and keeps the pytree static
+        spsi = spsi + jnp.einsum("bx,xy,yg->bg", bp, params.qmat, params.beta)
+    return hpsi * params.mask, spsi * params.mask

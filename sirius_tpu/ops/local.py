@@ -1,0 +1,52 @@
+"""Local part of H: kinetic + effective potential via batched FFTs.
+
+Reference: Local_operator::apply_h (src/hamiltonian/local_operator.cpp:273)
+runs a per-band loop of {backward FFT, multiply by V(r), forward FFT} with
+MPI shuffles around it. Here the whole band block transforms at once —
+jnp.fft.fftn batches over the leading axis, XLA fuses the potential multiply
+— which is the key TPU win (SURVEY.md §7 "hard parts").
+
+All functions are shape-polymorphic over leading batch axes and jit-able;
+they run inside the SCF step jit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(4,))
+def apply_local(
+    psi: jax.Array,  # [..., nb, ngk] complex PW coefficients
+    veff_r: jax.Array,  # [n1, n2, n3] real effective potential on the box
+    ekin: jax.Array,  # [ngk] |G+k|^2/2 (padded slots large -> masked below)
+    fft_index: jax.Array,  # [ngk] int32
+    dims: tuple[int, int, int],
+    mask: jax.Array | None = None,  # [ngk] 1/0 validity
+) -> jax.Array:
+    """H_loc psi = ekin * psi + FFT^-1[ V(r) * FFT[psi] ] (per band, batched)."""
+    n = dims[0] * dims[1] * dims[2]
+    batch = psi.shape[:-1]
+    if mask is not None:
+        psi = psi * mask
+    box = jnp.zeros(batch + (n,), dtype=psi.dtype).at[..., fft_index].add(psi)
+    fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1))
+    vfr = fr * veff_r  # broadcast over bands
+    vpsi = jnp.fft.fftn(vfr, axes=(-3, -2, -1)).reshape(batch + (n,))[..., fft_index]
+    ek = jnp.where(mask > 0, ekin, 0.0) if mask is not None else ekin
+    out = ek * psi + vpsi
+    if mask is not None:
+        out = out * mask
+    return out
+
+
+def psi_to_grid(psi: jax.Array, fft_index: jax.Array, dims: tuple[int, int, int]) -> jax.Array:
+    """psi(G) -> psi(r) on the box, batched; normalization: psi(r) = sum_G
+    c(G) e^{iGr} so that (1/N) sum_r |psi(r)|^2 = sum_G |c|^2."""
+    n = dims[0] * dims[1] * dims[2]
+    batch = psi.shape[:-1]
+    box = jnp.zeros(batch + (n,), dtype=psi.dtype).at[..., fft_index].add(psi)
+    return jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1)) * n

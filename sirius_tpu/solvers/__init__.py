@@ -1,0 +1,2 @@
+from sirius_tpu.solvers.eigen import eigh_gen, exact_diag
+from sirius_tpu.solvers.davidson import davidson

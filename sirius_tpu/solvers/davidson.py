@@ -1,0 +1,112 @@
+"""Blocked iterative eigensolver for (H, S), fixed-shape and jit-able.
+
+The reference uses a growing-subspace block Davidson with locking and
+restarts (src/hamiltonian/davidson.hpp:107-856). Growing subspaces mean
+dynamic shapes — poison for XLA — so the TPU design is a locked-block
+LOBPCG-style iteration with a constant 3*nb subspace [X, K R, P]:
+
+  1. R = H X - eval S X, soft-locked by convergence mask
+  2. K R: Teter-style diagonal preconditioner (reference residuals_aux.cu
+     apply_preconditioner: p = h_diag - e*o_diag; p <- (1+p+sqrt(1+(p-1)^2))/2)
+  3. Rayleigh-Ritz on V = [X, KR, P] with a rank-revealing (eigh-based)
+     overlap regularization instead of Cholesky — ill-conditioned subspace
+     directions are projected out, not crashed on
+  4. X' = V C_low, P' = V C_low minus the X-block contribution
+
+Every step is dense batched linear algebra (MXU) + the caller's H/S applies;
+the iteration count is static (config iterative_solver.num_steps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6):
+    """Lowest-nev gen-EVP of a possibly rank-deficient subspace pair."""
+    s, u = jnp.linalg.eigh(ssub)
+    smax = jnp.max(jnp.abs(s))
+    good = s > 1e-13 * smax
+    t = u * jnp.where(good, jax.lax.rsqrt(jnp.where(good, s, 1.0)), 0.0)[None, :]
+    at = t.conj().T @ hsub @ t
+    at = at + jnp.diag(jnp.where(good, 0.0, big).astype(at.dtype))
+    e, y = jnp.linalg.eigh(at)
+    c = t @ y
+    return e[:nev], c[:, :nev]
+
+
+def _precondition(r: jax.Array, h_diag: jax.Array, o_diag: jax.Array, eval_: jax.Array):
+    """Reference apply_preconditioner (residuals_aux.cu): smooth Teter-like."""
+    p = h_diag[None, :] - eval_[:, None] * o_diag[None, :]
+    p = 0.5 * (1.0 + p + jnp.sqrt(1.0 + (p - 1.0) ** 2))
+    return r / p
+
+
+@partial(jax.jit, static_argnames=("apply_fn", "num_steps"))
+def davidson(
+    apply_fn,  # (params, psi [nb, ng]) -> (h psi, s psi); a STABLE module-
+    # level function — closures would retrace the jit per call site
+    params,  # pytree of per-k Hamiltonian data (ops.hamiltonian.HkParams)
+    x0: jax.Array,  # [nb, ng] initial guess
+    h_diag: jax.Array,  # [ng] H diagonal (preconditioner)
+    o_diag: jax.Array,  # [ng] S diagonal
+    mask: jax.Array,  # [ng] valid-G mask
+    num_steps: int = 20,
+    res_tol: float = 1e-6,
+):
+    """Returns (eval [nb], X [nb, ng], res_norms [nb])."""
+    nb = x0.shape[0]
+
+    def apply_h_s(psi):
+        return apply_fn(params, psi)
+
+    def ortho(x):
+        g = (x * mask) @ (x * mask).conj().T
+        s, u = jnp.linalg.eigh(g)
+        good = s > 1e-12 * jnp.max(jnp.abs(s))
+        t = u * jnp.where(good, jax.lax.rsqrt(jnp.where(good, s, 1.0)), 0.0)[None, :]
+        return t.conj().T @ x
+
+    x = ortho(x0 * mask)
+
+    def step(carry, _):
+        x, p, evals = carry
+        hx, sx = apply_h_s(x)
+        # Ritz values of current block
+        evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
+        r = (hx - evals[:, None] * sx) * mask
+        rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(r) ** 2, axis=1)))
+        conv = rnorm < res_tol
+        r = jnp.where(conv[:, None], 0.0, _precondition(r, h_diag, o_diag, evals)) * mask
+        # project out X and normalize rows: keeps the 3nb overlap matrix
+        # well-conditioned so the rank-revealing cutoff doesn't stall
+        # convergence near the solution
+        r = r - (r @ x.conj().T) @ x
+        r = r / jnp.maximum(jnp.linalg.norm(r, axis=1, keepdims=True), 1e-30)
+        p = p / jnp.maximum(jnp.linalg.norm(p, axis=1, keepdims=True), 1e-30)
+        v = jnp.concatenate([x, r, p], axis=0)  # (3nb, ng)
+        hv, sv = apply_h_s(v)
+        hsub = v.conj() @ hv.T
+        ssub = v.conj() @ sv.T
+        hsub = 0.5 * (hsub + hsub.conj().T)
+        ssub = 0.5 * (ssub + ssub.conj().T)
+        e, c = _rayleigh_ritz(hsub, ssub, nb)
+        xn = (c.T @ v) * mask
+        # new search direction: the non-X part of the update
+        cp = c.at[:nb, :].set(0.0)
+        pn = (cp.T @ v) * mask
+        return (xn, pn, e), rnorm
+
+    (x, p, evals), rhist = jax.lax.scan(
+        step, (x, jnp.zeros_like(x), jnp.zeros(nb, x0.real.dtype)), None, length=num_steps
+    )
+    hx, sx = apply_h_s(x)
+    evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
+    rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(hx - evals[:, None] * sx) ** 2, axis=1)))
+    # normalize to <x|S|x> = 1
+    nrm = jnp.real(jnp.sum(x.conj() * sx, axis=1))
+    x = x / jnp.sqrt(nrm)[:, None]
+    return evals, x, rnorm

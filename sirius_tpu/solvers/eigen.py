@@ -1,0 +1,64 @@
+"""Dense eigensolvers: generalized Hermitian EVP and exact plane-wave
+diagonalization for verification (reference: Eigensolver_lapack
+eigenproblem.hpp:39 and diagonalize_pp_exact / pseudopotential_hmatrix.hpp).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def eigh_gen(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Solve A z = e B z for Hermitian A, HPD B via Cholesky reduction
+    (the reference's LAPACK hegvx path). Returns (e, z) with z B-orthonormal."""
+    l = jnp.linalg.cholesky(b)
+    linv = jax.scipy.linalg.solve_triangular(l, jnp.eye(l.shape[-1], dtype=l.dtype), lower=True)
+    astd = linv @ a @ linv.conj().T
+    e, y = jnp.linalg.eigh(astd)
+    z = linv.conj().T @ y
+    return e, z
+
+
+def build_h_s_matrices(
+    gkvec_ik: dict,
+    veff_g_fine: np.ndarray,
+    fine_index_of_miller,
+    beta_k: np.ndarray | None = None,
+    dion: np.ndarray | None = None,
+    qmat: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Dense H, S in the |G+k| basis for one k-point (verification path).
+
+    H_GG' = (|G+k|^2/2) delta + V_eff(G-G') + sum beta D beta^H
+    S_GG' = delta + sum beta Q beta^H
+    V_eff(G-G') is looked up in the fine G set via Miller differences.
+    """
+    mill = gkvec_ik["millers"]  # (ngk, 3) valid part only
+    ekin = gkvec_ik["ekin"]
+    ngk = len(mill)
+    dm = mill[:, None, :] - mill[None, :, :]
+    idx = fine_index_of_miller(dm.reshape(-1, 3)).reshape(ngk, ngk)
+    if np.any(idx < 0):
+        raise ValueError("fine G set does not contain all G-G' differences")
+    h = veff_g_fine[idx].astype(np.complex128)
+    h[np.arange(ngk), np.arange(ngk)] += ekin
+    s = np.eye(ngk, dtype=np.complex128)
+    if beta_k is not None and beta_k.shape[0]:
+        b = beta_k[:, :ngk]  # (nbeta, ngk)
+        h += b.conj().T @ dion @ b
+        if qmat is not None:
+            s += b.conj().T @ qmat @ b
+    return h, s
+
+
+def exact_diag(h: np.ndarray, s: np.ndarray | None, nev: int) -> tuple[np.ndarray, np.ndarray]:
+    """Lowest nev eigenpairs of (H, S) via scipy (host-side verification)."""
+    import scipy.linalg
+
+    if s is None:
+        e, v = scipy.linalg.eigh(h)
+    else:
+        e, v = scipy.linalg.eigh(h, s)
+    return e[:nev], v[:, :nev]

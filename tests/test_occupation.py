@@ -1,0 +1,51 @@
+"""Fermi search and smearing tests (mirrors reference smearing checks in
+k_point_set.cpp usage)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sirius_tpu.dft.occupation import entropy_term, find_fermi, occupancy
+
+
+def test_insulator_integer_occupations():
+    # 4 electrons, clear gap: two lowest bands full
+    evals = jnp.asarray(np.array([[[-1.0, -0.5, 1.0, 2.0]]]))
+    mu, occ, ent = find_fermi(evals, jnp.array([1.0]), 4.0, 0.01)
+    np.testing.assert_allclose(np.asarray(occ)[0, 0], [2, 2, 0, 0], atol=1e-10)
+    assert -0.5 < float(mu) < 1.0
+    assert abs(float(ent)) < 1e-10
+
+
+@pytest.mark.parametrize("kind", ["gaussian", "fermi_dirac", "cold", "methfessel_paxton"])
+def test_electron_count_conserved(kind):
+    rng = np.random.default_rng(0)
+    evals = jnp.asarray(np.sort(rng.standard_normal((3, 1, 10)), axis=-1))
+    w = jnp.array([0.5, 0.3, 0.2])
+    nel = 7.0
+    mu, occ, ent = find_fermi(evals, w, nel, 0.05, kind=kind)
+    n = float(jnp.sum(w[:, None, None] * occ))
+    np.testing.assert_allclose(n, nel, atol=1e-8)
+    assert float(ent) <= 1e-12  # entropy term is negative
+
+
+def test_occupancy_limits_and_monotonic():
+    x = jnp.linspace(-1, 1, 201)
+    for kind in ["gaussian", "fermi_dirac", "cold", "methfessel_paxton"]:
+        f = np.asarray(occupancy(kind, x, 0.05))
+        assert abs(f[0]) < 1e-8 and abs(f[-1] - 1) < 1e-8
+        if kind in ("gaussian", "fermi_dirac"):
+            assert np.all(np.diff(f) >= -1e-12)
+
+
+def test_fermi_dirac_entropy_analytic():
+    # at x=0: f=1/2, S = w ln(1/2)
+    w = 0.025
+    s = float(entropy_term("fermi_dirac", jnp.array([0.0]), w)[0])
+    np.testing.assert_allclose(s, w * np.log(0.5), rtol=1e-10)
+
+
+def test_spin_polarized_max_occupancy():
+    evals = jnp.asarray(np.array([[[-1.0, 0.5], [-0.9, 0.6]]]))  # nk=1, ns=2
+    mu, occ, ent = find_fermi(evals, jnp.array([1.0]), 2.0, 0.01, max_occupancy=1.0)
+    np.testing.assert_allclose(np.asarray(occ)[0, :, 0], [1.0, 1.0], atol=1e-8)
