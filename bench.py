@@ -1,0 +1,87 @@
+"""Benchmark: SCF-iteration wall time of the flagship PP-PW path.
+
+Workload: BASELINE config 1 class — 2-atom silicon, ultrasoft-style
+projectors, gk_cutoff 6 / pw_cutoff 20, Gamma-only, 26 bands — one full SCF
+iteration's band solve (20-step blocked Davidson = 123 H*psi applications
+per band block) plus the density reduction, in complex64 on the local
+accelerator.
+
+Baseline anchor: the reference's own verification run of the same class
+(verification/test08 output_ref.json: scf_time 6.33 s / 30 iterations =
+0.211 s per SCF iteration on the reference's CPU node; no per-GPU numbers
+are published in-tree, BASELINE.json "published": {}). vs_baseline =
+baseline_iter_time / measured_iter_time (>1 means faster than the reference
+CPU anchor).
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REF_ITER_TIME_S = 6.325581577 / 30  # test08 scf_time / num_scf_iterations
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", False)  # TPU path: f32/c64 only
+    import jax.numpy as jnp
+
+    from sirius_tpu.parallel.batched import davidson_kset, density_kset, make_hkset_params
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    platform = jax.devices()[0].platform
+    ctx = synthetic_silicon_context(
+        gk_cutoff=6.0, pw_cutoff=20.0, ngridk=(1, 1, 1), num_bands=26,
+        use_symmetry=False,
+    )
+    nk, ns, nb, ngk = 1, 1, 26, ctx.gkvec.ngk_max
+    num_steps = 20
+
+    params = make_hkset_params(
+        ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64
+    )
+    rng = np.random.default_rng(0)
+    psi = (
+        rng.standard_normal((nk, ns, nb, ngk)) + 1j * rng.standard_normal((nk, ns, nb, ngk))
+    ).astype(np.complex64) * ctx.gkvec.mask[:, None, None, :].astype(np.float32)
+    psi = jnp.asarray(psi)
+    occ_w = jnp.ones((nk, ns, nb), dtype=jnp.float32)
+
+    def one_iter(psi):
+        ev, psi2, rn = davidson_kset(params, psi, num_steps=num_steps)
+        rho = density_kset(params, psi2, occ_w)
+        return ev, psi2, rho
+
+    # warmup/compile
+    ev, psi2, rho = one_iter(psi)
+    jax.block_until_ready((ev, rho))
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ev, psi2, rho = one_iter(psi)
+        jax.block_until_ready((ev, rho))
+        times.append(time.perf_counter() - t0)
+    iter_time = float(np.median(times))
+
+    print(
+        json.dumps(
+            {
+                "metric": f"SCF-iteration wall time (band solve + density), "
+                f"Si-2atom US gk=6/pw=20 nb=26 c64 on {platform}",
+                "value": round(iter_time, 6),
+                "unit": "s/iteration",
+                "vs_baseline": round(REF_ITER_TIME_S / iter_time, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from sirius_tpu.dft.radial_tables import (
     structure_factors,
     vloc_form_factor,
 )
+from sirius_tpu.ops.augmentation import Augmentation
 from sirius_tpu.ops.beta import BetaProjectors
 
 
@@ -43,6 +44,7 @@ class SimulationContext:
     gkvec: GkVec
     kweights: np.ndarray
     beta: BetaProjectors
+    aug: Augmentation | None
     vloc_g: np.ndarray  # (ng_fine,) local potential
     rho_core_g: np.ndarray  # (ng_fine,)
     rho_atomic_g: np.ndarray  # (ng_fine,) superposition of free atoms
@@ -83,6 +85,16 @@ class SimulationContext:
         gkvec = GkVec.build(gvec, kpts, p.gk_cutoff, fft_coarse, weights=kw)
 
         beta = BetaProjectors.build(uc, gkvec, qmax=p.gk_cutoff + 1e-9)
+        aug = None
+        if any(t.augmentation for t in uc.atom_types):
+            aug = Augmentation.build(uc, gvec)
+            # assemble the block-diagonal S-operator integrals q_mtrx
+            qmat = np.zeros_like(beta.dion)
+            for ia, off, nbf in beta.atom_blocks(uc):
+                at = aug.per_type[uc.type_of_atom[ia]]
+                if at is not None:
+                    qmat[off : off + nbf, off : off + nbf] = at.q_mtrx
+            beta = dataclasses.replace(beta, qmat=qmat)
         sfact = structure_factors(uc, gvec)
         vloc_g = make_periodic_function(uc, gvec, vloc_form_factor, sfact)
         rho_core_g = make_periodic_function(uc, gvec, rho_core_form_factor, sfact)
@@ -115,6 +127,7 @@ class SimulationContext:
             gkvec=gkvec,
             kweights=kw,
             beta=beta,
+            aug=aug,
             vloc_g=vloc_g,
             rho_core_g=rho_core_g,
             rho_atomic_g=rho_at_g,

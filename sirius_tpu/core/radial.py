@@ -138,7 +138,9 @@ class RadialIntegralTable:
         num_q: int | None = None,
     ) -> "RadialIntegralTable":
         if num_q is None:
-            num_q = max(64, int(qmax * 12))
+            # reference density: ~20 points per unit q (settings.nprii_beta/
+            # nprii_aug = 20); coarser tables cost ~1e-5 Ha in total energy
+            num_q = max(128, int(qmax * 20) + 1)
         qgrid = np.linspace(0.0, qmax, num_q)
         tab = np.stack(
             [sbessel_integral(r, fn, int(l), qgrid, m=m) for fn, l in zip(functions, ls)]

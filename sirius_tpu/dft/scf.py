@@ -11,22 +11,38 @@ from __future__ import annotations
 import json
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from sirius_tpu.config.schema import Config, load_config
 from sirius_tpu.context import SimulationContext
-from sirius_tpu.dft.density import generate_density_g, initial_density_g, rho_real_space
+from sirius_tpu.dft.density import (
+    generate_density_g,
+    initial_density_g,
+    rho_real_space,
+    symmetrize_pw,
+)
 from sirius_tpu.dft.mixer import Mixer
 from sirius_tpu.dft.occupation import find_fermi
 from sirius_tpu.dft.potential import generate_potential
 from sirius_tpu.dft.xc import XCFunctional
 from sirius_tpu.ops.atomic import atomic_orbitals
+from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
 
 
-def _h_o_diag(ctx: SimulationContext, ik: int, v0: float):
+@jax.jit
+def _density_matrix_k(beta_gk, psi, occ_w):
+    """n_{xi1 xi2} = sum_{s,b} occ_w conj(<beta_xi1|psi>) <beta_xi2|psi>
+    for one k-point (reference add_k_point_contribution_dm_pwpp,
+    density.cpp:847-901)."""
+    bp = jnp.einsum("xg,sbg->sbx", jnp.conj(beta_gk), psi)
+    return jnp.einsum("sb,sbx,sby->xy", occ_w, jnp.conj(bp), bp)
+
+
+def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
     """Diagonals of H and S for the preconditioner (reference
     get_h_o_diag_pw)."""
     ekin = ctx.gkvec.kinetic()[ik]
@@ -34,7 +50,7 @@ def _h_o_diag(ctx: SimulationContext, ik: int, v0: float):
     o = np.ones_like(h)
     if ctx.beta.num_beta_total:
         b = ctx.beta.beta_gk[ik]
-        h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), ctx.beta.dion, b))
+        h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), dmat, b))
         if ctx.beta.qmat is not None:
             o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), ctx.beta.qmat, b))
     return np.where(ctx.gkvec.mask[ik] > 0, h, 1e4), np.where(
@@ -80,20 +96,20 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
             f"num_bands={nb} cannot hold {nel} electrons "
             f"(max {nb * ctx.max_occupancy * ctx.num_spins})"
         )
-    if ctx.beta.qmat is not None:
-        # S-normalization without the augmentation charge in the density
-        # would silently violate charge conservation
-        raise NotImplementedError(
-            "ultrasoft/PAW augmentation charge is not implemented yet; "
-            "only norm-conserving species are supported in this revision"
-        )
     if ctx.num_mag_dims != 0:
         raise NotImplementedError("magnetism lands after the ultrasoft layer")
+    if any(t.pseudo_type == "PAW" for t in ctx.unit_cell.atom_types):
+        raise NotImplementedError("PAW on-site terms are not implemented yet")
 
     rho_g = initial_density_g(ctx)
     pot = generate_potential(ctx, rho_g, xc)
     psi = _initial_subspace(ctx)
     mixer = Mixer(cfg.mixer, ctx.gvec.glen2)
+    # constant device tables, uploaded once (not per iteration)
+    beta_dev = [jnp.asarray(ctx.beta.beta_gk[ik]) for ik in range(nk)]
+    do_symmetrize = (
+        p.use_symmetry and ctx.symmetry is not None and ctx.symmetry.num_ops > 1
+    )
 
     evals = np.zeros((nk, ns, nb))
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
@@ -104,11 +120,15 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
 
     for it in range(p.num_dft_iter):
         # --- band solve per k (warm start) ---
+        if ctx.aug is not None:
+            d_full = d_operator(ctx.unit_cell, ctx.gvec, ctx.aug, pot.veff_g, ctx.beta)
+        else:
+            d_full = ctx.beta.dion
         new_psi = []
         for ik in range(nk):
-            params = make_hk_params(ctx, ik, pot.veff_r_coarse)
+            params = make_hk_params(ctx, ik, pot.veff_r_coarse, d_full)
             v0 = float(np.real(pot.veff_g[0]))
-            h_diag, o_diag = _h_o_diag(ctx, ik, v0)
+            h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_full)
             per_spin = []
             for ispn in range(ns):
                 ev, x, rn = davidson(
@@ -138,7 +158,21 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
         occ_np = np.asarray(occ)
 
         # --- density ---
-        rho_new = generate_density_g(ctx, psi, occ_np, symmetrize=p.use_symmetry)
+        rho_new = generate_density_g(ctx, psi, occ_np, symmetrize=False)
+        if ctx.aug is not None:
+            dm_full = np.zeros(
+                (ctx.beta.num_beta_total, ctx.beta.num_beta_total), dtype=np.complex128
+            )
+            for ik in range(nk):
+                ow = jnp.asarray(occ_np[ik] * ctx.kweights[ik])
+                dm_full += np.asarray(_density_matrix_k(beta_dev[ik], psi[ik], ow))
+            dm_blocks = [
+                dm_full[off : off + nbf, off : off + nbf]
+                for _, off, nbf in ctx.beta.atom_blocks(ctx.unit_cell)
+            ]
+            rho_new = rho_new + rho_aug_g(ctx.unit_cell, ctx.gvec, ctx.aug, dm_blocks)
+        if do_symmetrize:
+            rho_new = symmetrize_pw(ctx, rho_new)
         rms = mixer.rms(rho_g, rho_new)
         rho_mixed = mixer.mix(rho_g, rho_new)
         rho_g = rho_mixed

@@ -1,0 +1,171 @@
+"""Ultrasoft/PAW augmentation operator Q(G) and its contractions.
+
+Reference: src/density/augmentation_operator.cpp (Q_{xi xi'}(G) tables),
+Density::generate_rho_aug (density.cpp:1395, GPU kernels sum_q_pw_dm_pw.cu)
+and Potential::generate_D_operator_matrix (generate_d_operator_matrix.cpp:26).
+
+Conventions (validated against the reference):
+  Q_{xi1 xi2}(G) = (4 pi / Omega) sum_{lm3} (-i)^{l3} R_{lm3}(^G)
+                   <R_{lm1} R_{lm2} R_{lm3}>  RI_aug(rf12, l3, |G|)
+  RI_aug(rf12, l3, q) = int j_{l3}(q r) Q^{l3}_{rf1 rf2}(r) dr
+                        (species files store Q(r) including the r^2 factor)
+  q_mtrx = Omega * Q(G=0)            (augmentation_operator.cpp:100-110)
+  rho_aug(G) = sum_a sum_{xi1 xi2} n^a_{xi1 xi2} Q_{xi1 xi2}(G) e^{-i G r_a}
+  D^a_{xi1 xi2} = d_ion + Omega * sum_G conj(V_eff(G)) Q_{xi1 xi2}(G) e^{-i G r_a}
+  n^a_{xi1 xi2} = sum_{k,s,b} w_k f conj(<beta_xi1|psi>) <beta_xi2|psi>
+
+Only the packed upper triangle of (xi1 <= xi2) is stored, mirroring the
+reference's nqlm = nbf(nbf+1)/2 layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from sirius_tpu.core.gvec import Gvec
+from sirius_tpu.core.radial import RadialIntegralTable
+from sirius_tpu.core.sht import gaunt_rlm, lm_index, num_lm, ylm_real
+from sirius_tpu.crystal.unit_cell import UnitCell
+
+
+@dataclasses.dataclass
+class AugmentationType:
+    """Per-species augmentation tables."""
+
+    q_pw: np.ndarray  # (nqlm, ng) complex: Q_{packed}(G), no atom phase
+    xi1: np.ndarray  # (nqlm,) unpacked pair indices
+    xi2: np.ndarray
+    q_mtrx: np.ndarray  # (nbf, nbf) = Omega * Q(0)
+
+
+@dataclasses.dataclass
+class Augmentation:
+    per_type: list[AugmentationType | None]
+
+    @staticmethod
+    def build(uc: UnitCell, gvec: Gvec) -> "Augmentation":
+        out = []
+        for t in uc.atom_types:
+            out.append(_build_type(t, gvec, uc.omega) if t.augmentation else None)
+        return Augmentation(per_type=out)
+
+
+def _build_type(t, gvec: Gvec, omega: float) -> AugmentationType:
+    lb = t.lmax_beta
+    lmax3 = 2 * lb
+    nbf = t.num_beta_lm
+    idxrf, ls, ms = t.beta_lm_table()
+    nbrf = t.num_beta
+
+    # radial integrals RI(packed rf12, l3, |G| shell)
+    nrf12 = nbrf * (nbrf + 1) // 2
+    qfuncs = np.zeros((nrf12, lmax3 + 1, len(t.r)))
+    for ch in t.augmentation:
+        i, j = min(ch.i, ch.j), max(ch.i, ch.j)
+        idx = j * (j + 1) // 2 + i
+        qfuncs[idx, ch.l, : len(ch.qr)] = ch.qr
+    qshell = np.sqrt(gvec.shell_g2)
+    ri = np.zeros((nrf12, lmax3 + 1, gvec.num_shells))
+    for l3 in range(lmax3 + 1):
+        tab = RadialIntegralTable.build(
+            t.r, qfuncs[:, l3, :], np.full(nrf12, l3), qmax=qshell[-1] + 1e-9, m=0
+        )
+        ri[:, l3, :] = tab(qshell)
+
+    # angular part
+    glen = np.sqrt(gvec.glen2)
+    rhat = np.where(
+        glen[:, None] > 1e-30, gvec.gcart / np.maximum(glen, 1e-30)[:, None], np.array([0.0, 0, 1.0])
+    )
+    rlm3 = ylm_real(lmax3, rhat)  # (ng, nlm3)
+    gaunt = gaunt_rlm(lb, lb, lmax3)  # (lm1, lm2, lm3)
+    mi_l3 = np.asarray([(-1j) ** l for l in range(lmax3 + 1)])
+    l_of_lm3 = np.asarray([int(np.sqrt(lm)) for lm in range(num_lm(lmax3))])
+
+    nqlm = nbf * (nbf + 1) // 2
+    q_pw = np.zeros((nqlm, gvec.num_gvec), dtype=np.complex128)
+    xi1 = np.zeros(nqlm, dtype=np.int32)
+    xi2 = np.zeros(nqlm, dtype=np.int32)
+    pref = 4.0 * np.pi / omega
+    for b in range(nbf):
+        for a in range(b + 1):
+            idx12 = b * (b + 1) // 2 + a
+            xi1[idx12], xi2[idx12] = a, b
+            ra, rb = int(idxrf[a]), int(idxrf[b])
+            rf12 = max(ra, rb) * (max(ra, rb) + 1) // 2 + min(ra, rb)
+            lm_a = lm_index(int(ls[a]), int(ms[a]))
+            lm_b = lm_index(int(ls[b]), int(ms[b]))
+            # sum over lm3 with nonzero Gaunt
+            acc = np.zeros(gvec.num_gvec, dtype=np.complex128)
+            for lm3 in np.nonzero(np.abs(gaunt[lm_a, lm_b]) > 1e-14)[0]:
+                l3 = l_of_lm3[lm3]
+                acc += (
+                    mi_l3[l3]
+                    * gaunt[lm_a, lm_b, lm3]
+                    * rlm3[:, lm3]
+                    * ri[rf12, l3, gvec.shell_idx]
+                )
+            q_pw[idx12] = pref * acc
+    q0 = q_pw[:, 0].real * omega
+    q_mtrx = np.zeros((nbf, nbf))
+    q_mtrx[xi2, xi1] = q0
+    q_mtrx[xi1, xi2] = q0
+    return AugmentationType(q_pw=q_pw, xi1=xi1, xi2=xi2, q_mtrx=q_mtrx)
+
+
+def rho_aug_g(
+    uc: UnitCell,
+    gvec: Gvec,
+    aug: Augmentation,
+    dm: list,  # per-atom (nbf_a, nbf_a) complex density-matrix blocks
+) -> np.ndarray:
+    """Augmentation charge rho_aug(G) on the fine set."""
+    out = np.zeros(gvec.num_gvec, dtype=np.complex128)
+    for it, at in enumerate(aug.per_type):
+        if at is None:
+            continue
+        atoms = uc.atoms_of_type(it)
+        nbf = uc.atom_types[it].num_beta_lm
+        # packed real dm with factor 2 off-diagonal:
+        # sum_{xi1 xi2} n Q = sum_packed w * Re(n) * Q  (n hermitian, Q sym)
+        w = np.where(at.xi1 == at.xi2, 1.0, 2.0)
+        dmp = np.stack(
+            [w * np.real(dm[ia][at.xi1, at.xi2]) for ia in atoms]
+        )  # (na_t, nqlm)
+        phases = np.exp(-2j * np.pi * (gvec.millers @ uc.positions[atoms].T))  # (ng, na_t)
+        # (ng, na_t) @ (na_t, nqlm) -> then contract with q_pw
+        out += np.einsum("ga,aq,qg->g", phases, dmp, at.q_pw, optimize=True)
+    return out
+
+
+def d_operator(
+    uc: UnitCell,
+    gvec: Gvec,
+    aug: Augmentation,
+    veff_g: np.ndarray,
+    beta,  # BetaProjectors (bare D + packed block layout)
+) -> np.ndarray:
+    """Full D matrix: bare D_ion plus the augmentation term
+    Omega sum_G conj(V_eff(G)) Q(G) e^{-i G r_a} per atom."""
+    d = beta.dion.copy()
+    omega = uc.omega
+    vq_by_atom = {}
+    for it, at in enumerate(aug.per_type):
+        if at is None:
+            continue
+        atoms = uc.atoms_of_type(it)
+        phases = np.exp(-2j * np.pi * (gvec.millers @ uc.positions[atoms].T))  # (ng, na_t)
+        vq = omega * np.real(at.q_pw @ (np.conj(veff_g)[:, None] * phases))  # (nqlm, na_t)
+        for j, ia in enumerate(atoms):
+            vq_by_atom[ia] = (at, vq[:, j])
+    for ia, off, nbf in beta.atom_blocks(uc):
+        if ia not in vq_by_atom:
+            continue
+        at, v = vq_by_atom[ia]
+        block = np.zeros((nbf, nbf))
+        block[at.xi1, at.xi2] = v
+        block[at.xi2, at.xi1] = v
+        d[off : off + nbf, off : off + nbf] += block
+    return d

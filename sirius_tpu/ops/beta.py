@@ -47,10 +47,18 @@ class BetaProjectors:
     qmat: np.ndarray | None
     atom_of_beta: np.ndarray
     l_of_beta: np.ndarray
+    offsets: np.ndarray  # (natom,) start of each atom's projector block
 
     @property
     def num_beta_total(self) -> int:
         return self.beta_gk.shape[1]
+
+    def atom_blocks(self, uc: UnitCell):
+        """Yield (ia, start, nbf) for each atom's projector block — the
+        single source of truth for the packed projector layout."""
+        for ia in range(uc.num_atoms):
+            nbf = uc.atom_types[uc.type_of_atom[ia]].num_beta_lm
+            yield ia, int(self.offsets[ia]), nbf
 
     @staticmethod
     def build(uc: UnitCell, gkvec: GkVec, qmax: float) -> "BetaProjectors":
@@ -77,9 +85,7 @@ class BetaProjectors:
         atom_of_beta = np.zeros(nbeta_tot, dtype=np.int32)
         l_of_beta = np.zeros(nbeta_tot, dtype=np.int32)
         dion = np.zeros((nbeta_tot, nbeta_tot))
-        qmat_blocks = []
-        have_q = any(t.augmentation for t in uc.atom_types)
-        qmat = np.zeros((nbeta_tot, nbeta_tot)) if have_q else None
+        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
 
         if nbeta_tot and lmax >= 0:
             gk = gkvec.gkcart  # (nk, ngk, 3)
@@ -118,41 +124,14 @@ class BetaProjectors:
                 dion[off : off + t.num_beta_lm, off : off + t.num_beta_lm] = np.where(
                     sel, t.d_ion[np.ix_(idxrf, idxrf)], 0.0
                 )
-                if have_q and t.augmentation:
-                    qmat[off : off + t.num_beta_lm, off : off + t.num_beta_lm] = _q_integrals(t)
                 off += t.num_beta_lm
+        # qmat (S-operator integrals) is assembled by the SimulationContext
+        # from the Augmentation tables: q_mtrx = Omega * Q(G=0) exactly.
         return BetaProjectors(
             beta_gk=beta_gk,
             dion=dion,
-            qmat=qmat,
+            qmat=None,
             atom_of_beta=atom_of_beta,
             l_of_beta=l_of_beta,
+            offsets=offsets,
         )
-
-
-def _q_integrals(t) -> np.ndarray:
-    """<Q_{xi xi'}> = int Q_ij^{l=0-channel} expansion: the integral of the
-    augmentation function over the cell, lm-expanded:
-    q_ij = int Q_ij(r) r^2 dr * delta_ll' delta_mm' selection via Gaunt with
-    the l=0 channel: int Q_{xi xi'}(r) dr = q_ij^{l=0} <R_00 R_lm R_l'm'>
-    * sqrt(4 pi) -> q_ij delta_{lm,l'm'} for the radial channel l=0.
-
-    Reference: Augmentation_operator q_mtrx (augmentation_operator.cpp);
-    only the l=0 channel survives the full-cell integral."""
-    from sirius_tpu.core.radial import spline_quadrature_weights
-
-    idxrf, ls, ms = t.beta_lm_table()
-    n = t.num_beta_lm
-    q = np.zeros((n, n))
-    w = spline_quadrature_weights(t.r)
-    # radial integrals of the l-channel augmentation functions
-    qij0 = np.zeros((t.num_beta, t.num_beta))
-    for ch in t.augmentation:
-        if ch.l == 0:
-            val = float(np.sum(w * ch.qr))  # file stores Q(r) incl r^2? see tests
-            qij0[ch.i, ch.j] = qij0[ch.j, ch.i] = val
-    for a in range(n):
-        for b in range(n):
-            if ls[a] == ls[b] and ms[a] == ms[b]:
-                q[a, b] = qij0[idxrf[a], idxrf[b]]
-    return q

@@ -27,7 +27,11 @@ class HkParams(NamedTuple):
     qmat: jax.Array  # [nbeta, nbeta]; all-zero if norm-conserving
 
 
-def make_hk_params(ctx, ik: int, veff_r_coarse: np.ndarray) -> HkParams:
+def make_hk_params(
+    ctx, ik: int, veff_r_coarse: np.ndarray, dmat: np.ndarray | None = None
+) -> HkParams:
+    """dmat: full D matrix (bare D_ion + ultrasoft V_eff augmentation term);
+    defaults to the bare D_ion for norm-conserving runs."""
     nbeta = ctx.beta.num_beta_total
     beta = ctx.beta.beta_gk[ik] if nbeta else np.zeros((0, ctx.gkvec.ngk_max))
     qmat = (
@@ -41,7 +45,7 @@ def make_hk_params(ctx, ik: int, veff_r_coarse: np.ndarray) -> HkParams:
         mask=jnp.asarray(ctx.gkvec.mask[ik]),
         fft_index=jnp.asarray(ctx.gkvec.fft_index[ik]),
         beta=jnp.asarray(beta, dtype=jnp.complex128),
-        dion=jnp.asarray(ctx.beta.dion),
+        dion=jnp.asarray(ctx.beta.dion if dmat is None else dmat),
         qmat=jnp.asarray(qmat),
     )
 

@@ -1,0 +1,2 @@
+from sirius_tpu.parallel.mesh import make_mesh, shard_kset
+from sirius_tpu.parallel.batched import davidson_kset, HkSetParams, make_hkset_params

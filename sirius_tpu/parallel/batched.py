@@ -1,0 +1,129 @@
+"""K-set-batched band solve: the whole k-point loop as ONE jitted/vmapped
+computation, shardable over the ("k", "b") mesh.
+
+The reference loops local k-points serially per MPI rank
+(diagonalize.hpp:58); on TPU the padded fixed-shape per-k arrays (GkVec)
+make the entire k-set one vmapped davidson call — a single XLA program that
+shards over the mesh with zero hand-written collectives (density reduction
+over "k" is a psum XLA inserts from the einsum).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.ops.hamiltonian import HkParams, apply_h_s
+from sirius_tpu.solvers.davidson import davidson
+
+
+class HkSetParams(NamedTuple):
+    """Batched-over-k Hamiltonian data (leading axis nk on per-k leaves)."""
+
+    veff_r: jax.Array  # [n1,n2,n3] shared
+    ekin: jax.Array  # [nk, ngk]
+    mask: jax.Array  # [nk, ngk]
+    fft_index: jax.Array  # [nk, ngk]
+    beta: jax.Array  # [nk, nbeta, ngk]
+    dion: jax.Array  # [nbeta, nbeta] shared
+    qmat: jax.Array  # [nbeta, nbeta] shared
+    h_diag: jax.Array  # [nk, ngk]
+    o_diag: jax.Array  # [nk, ngk]
+
+
+def make_hkset_params(
+    ctx, veff_r_coarse, d_full=None, dtype=jnp.complex128, v0: float = 0.0
+) -> HkSetParams:
+    """v0: average effective potential veff(G=0), included in the
+    preconditioner diagonal exactly like the serial path (_h_o_diag)."""
+    nbeta = ctx.beta.num_beta_total
+    nk = ctx.gkvec.num_kpoints
+    dion = ctx.beta.dion if d_full is None else d_full
+    qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros((nbeta, nbeta))
+    rdtype = jnp.float32 if dtype == jnp.complex64 else jnp.float64
+    ekin = ctx.gkvec.kinetic()
+    h_diag = np.empty((nk, ctx.gkvec.ngk_max))
+    o_diag = np.empty_like(h_diag)
+    for ik in range(nk):
+        b = ctx.beta.beta_gk[ik]
+        h = ekin[ik] + v0
+        o = np.ones_like(h)
+        if nbeta:
+            h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), dion, b))
+            o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), qmat, b))
+        h_diag[ik] = np.where(ctx.gkvec.mask[ik] > 0, h, 1e4)
+        o_diag[ik] = np.where(ctx.gkvec.mask[ik] > 0, o, 1.0)
+    beta = (
+        ctx.beta.beta_gk
+        if nbeta
+        else np.zeros((nk, 0, ctx.gkvec.ngk_max), dtype=np.complex128)
+    )
+    return HkSetParams(
+        veff_r=jnp.asarray(veff_r_coarse, dtype=rdtype),
+        ekin=jnp.asarray(ekin, dtype=rdtype),
+        mask=jnp.asarray(ctx.gkvec.mask, dtype=rdtype),
+        fft_index=jnp.asarray(ctx.gkvec.fft_index),
+        beta=jnp.asarray(beta, dtype=dtype),
+        dion=jnp.asarray(dion, dtype=rdtype),
+        qmat=jnp.asarray(qmat, dtype=rdtype),
+        h_diag=jnp.asarray(h_diag, dtype=rdtype),
+        o_diag=jnp.asarray(o_diag, dtype=rdtype),
+    )
+
+
+def _davidson_one_k(params_k: HkParams, h_diag, o_diag, x0, num_steps, res_tol):
+    return davidson(
+        apply_h_s, params_k, x0, h_diag, o_diag, params_k.mask,
+        num_steps=num_steps, res_tol=res_tol,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_steps",))
+def davidson_kset(params: HkSetParams, psi, num_steps: int = 20, res_tol: float = 1e-6):
+    """Solve bands at every (k, spin) in one vmapped call.
+
+    psi: [nk, ns, nb, ngk] -> (evals [nk, ns, nb], psi', rnorm [nk, ns, nb]).
+    """
+
+    def one_k(ekin, mask, fft_index, beta, h_diag, o_diag, psi_k):
+        pk = HkParams(
+            veff_r=params.veff_r,
+            ekin=ekin,
+            mask=mask,
+            fft_index=fft_index,
+            beta=beta,
+            dion=params.dion,
+            qmat=params.qmat,
+        )
+
+        def one_spin(x0):
+            return _davidson_one_k(pk, h_diag, o_diag, x0, num_steps, res_tol)
+
+        return jax.vmap(one_spin)(psi_k)
+
+    return jax.vmap(one_k)(
+        params.ekin, params.mask, params.fft_index, params.beta,
+        params.h_diag, params.o_diag, psi,
+    )
+
+
+@jax.jit
+def density_kset(params: HkSetParams, psi, occ_w):
+    """Coarse-box density sum_{k,s,b} occ_w |psi(r)|^2 — contracts over the
+    whole k-set in one program (psum over "k" under sharding).
+
+    occ_w: [nk, ns, nb] occupation x k-weight."""
+    dims = params.veff_r.shape
+    n = dims[0] * dims[1] * dims[2]
+
+    def one_k(fft_index, psi_k, ow):
+        batch = psi_k.shape[:-1]
+        box = jnp.zeros(batch + (n,), dtype=psi_k.dtype).at[..., fft_index].add(psi_k)
+        fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1)) * n
+        return jnp.einsum("sb,sbxyz->xyz", ow, jnp.abs(fr) ** 2)
+
+    return jnp.sum(jax.vmap(one_k)(params.fft_index, psi, occ_w), axis=0)

@@ -1,0 +1,49 @@
+"""Device meshes and sharding for distributed SCF.
+
+The reference's 3-level MPI product grid world = comm_k x (npr x npc)
+(simulation_context.cpp:1300-1349) maps to one jax.sharding.Mesh with axes
+
+  "k" — k-point parallelism (embarrassingly parallel band solves; only the
+        density reduction and Fermi sync cross it -> psum over "k");
+  "b" — band parallelism (batched FFTs are per-band independent; subspace
+        Gram matrices contract over bands -> XLA inserts all-gathers);
+
+G-vector sharding (the reference's z-column/SpFFT slab axis) composes with
+these via sharded FFT boxes and is introduced when single-replica boxes stop
+fitting; at the sizes of the verification suite k x b sharding saturates the
+chips first.
+
+Everything uses GSPMD through jit + NamedSharding: the solver code is the
+same single-device code; collectives are inserted by XLA (SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_k: int | None = None, num_b: int | None = None) -> Mesh:
+    """Mesh over all available devices, factored as ("k", "b").
+
+    By default puts as many devices on "k" as divide the device count."""
+    devs = np.array(jax.devices())
+    n = len(devs)
+    if num_k is None:
+        num_k = n
+        num_b = 1
+    if num_b is None:
+        num_b = n // num_k
+    assert num_k * num_b == n, f"{num_k}*{num_b} != {n} devices"
+    return Mesh(devs.reshape(num_k, num_b), ("k", "b"))
+
+
+def shard_kset(mesh: Mesh, psi):
+    """Shard a [nk, ns, nb, ngk] wave-function array: k-points over "k",
+    bands over "b"."""
+    return jax.device_put(psi, NamedSharding(mesh, P("k", None, "b", None)))
+
+
+def kset_spec() -> P:
+    return P("k", None, "b", None)

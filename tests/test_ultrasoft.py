@@ -1,0 +1,63 @@
+"""Ultrasoft augmentation tests: Q(G) internal consistency + the full Si
+ultrasoft SCF against the reference (verification/test08, BASELINE config 1).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sirius_tpu.config import load_config
+from tests.conftest import REFERENCE_ROOT, requires_reference
+
+
+@requires_reference
+def test_q_pw_consistency():
+    """q_mtrx = Omega*Q(0) must equal the direct radial integral of the l=0
+    channel, and Q(G) must carry the hermiticity that makes rho_aug real."""
+    from sirius_tpu.context import SimulationContext
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test08")
+    cfg = load_config(os.path.join(base, "sirius.json"))
+    ctx = SimulationContext.create(cfg, base)
+    at = ctx.aug.per_type[0]
+    t = ctx.unit_cell.atom_types[0]
+    # direct l=0 radial integrals
+    from sirius_tpu.core.radial import spline_quadrature_weights
+
+    w = spline_quadrature_weights(t.r)
+    idxrf, ls, ms = t.beta_lm_table()
+    for ch in t.augmentation:
+        if ch.l != 0:
+            continue
+        val = float(np.sum(w[: len(ch.qr)] * ch.qr))
+        # find a diagonal-lm packed entry with these radial functions
+        for idx in range(len(at.xi1)):
+            a, b = at.xi1[idx], at.xi2[idx]
+            if (
+                idxrf[a] == ch.i
+                and idxrf[b] == ch.j
+                and ls[a] == ls[b]
+                and ms[a] == ms[b]
+            ):
+                np.testing.assert_allclose(at.q_mtrx[a, b], val, rtol=1e-6)
+                break
+    # S-operator integrals are symmetric
+    np.testing.assert_allclose(at.q_mtrx, at.q_mtrx.T, atol=1e-14)
+
+
+@requires_reference
+@pytest.mark.slow
+def test_scf_si_ultrasoft_test08():
+    from sirius_tpu.dft.scf import run_scf
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test08")
+    cfg = load_config(os.path.join(base, "sirius.json"))
+    res = run_scf(cfg, base)
+    with open(os.path.join(base, "output_ref.json")) as f:
+        ref = json.load(f)["ground_state"]
+    assert res["converged"]
+    assert abs(res["energy"]["total"] - ref["energy"]["total"]) < 1e-5
+    assert abs(res["energy"]["eval_sum"] - ref["energy"]["eval_sum"]) < 1e-5
+    assert abs(res["efermi"] - ref["efermi"]) < 1e-5
