@@ -15,7 +15,7 @@ import numpy as np
 class Mixer:
     KNOWN = ("linear", "anderson", "anderson_stable", "broyden2")
 
-    def __init__(self, cfg, glen2: np.ndarray | None = None):
+    def __init__(self, cfg, glen2: np.ndarray | None = None, num_components: int = 1):
         if cfg.type not in self.KNOWN:
             raise ValueError(
                 f"unknown mixer type '{cfg.type}' (supported: {self.KNOWN})"
@@ -25,8 +25,11 @@ class Mixer:
         self.kind = cfg.type
         self.weight = None
         if cfg.use_hartree and glen2 is not None:
+            # Hartree metric on the charge component; plain l2 on the others
+            # (magnetization), matching the reference mixer_functions.cpp
             g2 = np.where(glen2 > 1e-12, glen2, np.inf)
-            self.weight = 4.0 * np.pi / g2
+            w = 4.0 * np.pi / g2
+            self.weight = np.concatenate([w] + [np.ones_like(w)] * (num_components - 1))
         self._x: list[np.ndarray] = []  # input history
         self._f: list[np.ndarray] = []  # residual history f = x_out - x_in
 

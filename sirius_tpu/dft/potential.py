@@ -1,6 +1,12 @@
 """Effective potential generation (reference: src/potential/potential.cpp:236
-Potential::generate, PP-PW branch): Poisson -> XC -> V_eff assembly, plus all
-the energy integrals the reference reports (energy.hpp:280 energy_dict).
+Potential::generate): Poisson -> XC (unpolarized or collinear) -> V_eff
+assembly, plus the energy integrals the reference reports (energy.hpp:280).
+
+Collinear magnetism follows the reference's Field4D layout: charge rho and
+magnetization m_z; the XC potential splits into the charge part V_xc and the
+field B_z = (V_up - V_dn)/2 applied with opposite sign per spin
+(potential/xc.cpp). Spin-independent pieces (V_loc, V_H) enter both spin
+channels.
 """
 
 from __future__ import annotations
@@ -19,101 +25,161 @@ from sirius_tpu.dft.xc import XCFunctional
 
 @dataclasses.dataclass
 class PotentialResult:
-    veff_g: np.ndarray  # fine G
-    veff_r_coarse: np.ndarray  # coarse box, for H application
+    veff_g: np.ndarray  # fine G: charge part (V_loc + V_H + V_xc)
+    bz_g: np.ndarray | None  # fine G: z field B_z (collinear) or None
+    veff_r_coarse: np.ndarray  # [ns, coarse box] per-spin V for H application
     vha_g: np.ndarray
-    vxc_r: np.ndarray  # fine box
-    exc_r: np.ndarray  # fine box (energy density)
+    vxc_g: np.ndarray  # fine G: XC potential alone (forces/NLCC)
     energies: dict
+
+
+def _to_r(ctx, f_g):
+    return np.asarray(
+        g_to_r(jnp.asarray(f_g), jnp.asarray(ctx.gvec.fft_index), ctx.gvec.fft.dims)
+    ).real
+
+
+def _to_g(ctx, f_r):
+    return np.asarray(
+        r_to_g(
+            jnp.asarray(f_r.astype(np.complex128)),
+            jnp.asarray(ctx.gvec.fft_index),
+            ctx.gvec.fft.dims,
+        )
+    )
 
 
 def _inner_rr(ctx: SimulationContext, f_r: np.ndarray, g_r: np.ndarray) -> float:
     """Real-space integral over the cell: (Omega/N) sum_r f g."""
-    n = f_r.size
-    return float(np.sum(f_r * g_r) * ctx.unit_cell.omega / n)
+    return float(np.sum(f_r * g_r) * ctx.unit_cell.omega / f_r.size)
+
+
+def _gradient_r(ctx, f_g):
+    """grad f as three real-space fields."""
+    return [
+        _to_r(ctx, 1j * ctx.gvec.gcart[:, i] * f_g) for i in range(3)
+    ]
+
+
+def _divergence_g(ctx, vec_r):
+    """div of a real-space vector field, returned in G space."""
+    out = np.zeros(ctx.gvec.num_gvec, dtype=np.complex128)
+    for i in range(3):
+        out += 1j * ctx.gvec.gcart[:, i] * _to_g(ctx, vec_r[i])
+    return out
 
 
 def generate_potential(
     ctx: SimulationContext,
     rho_g: np.ndarray,
     xc: XCFunctional,
+    mag_g: np.ndarray | None = None,
 ) -> PotentialResult:
-    gv = ctx.gvec
-    dims = gv.fft.dims
-    fft_index = jnp.asarray(gv.fft_index)
-    omega = ctx.unit_cell.omega
+    dims = ctx.gvec.fft.dims
+    polarized = mag_g is not None
 
-    # Hartree
-    vha_g = np.asarray(hartree_potential_g(jnp.asarray(rho_g), jnp.asarray(gv.glen2)))
-    # real-space densities
-    rho_r = np.asarray(g_to_r(jnp.asarray(rho_g), fft_index, dims)).real
-    rho_core_r = (
-        np.asarray(g_to_r(jnp.asarray(ctx.rho_core_g), fft_index, dims)).real
-        if np.any(ctx.rho_core_g)
-        else np.zeros(dims)
+    vha_g = np.asarray(
+        hartree_potential_g(jnp.asarray(rho_g), jnp.asarray(ctx.gvec.glen2))
     )
-    rho_xc = np.maximum(rho_r + rho_core_r, 0.0)
+    rho_r = _to_r(ctx, rho_g)
+    rho_core_r = (
+        _to_r(ctx, ctx.rho_core_g) if np.any(ctx.rho_core_g) else np.zeros(dims)
+    )
 
-    # XC (LDA for now; GGA needs gradients — computed in G space)
-    if xc.is_gga:
-        grad = [
-            np.asarray(
-                g_to_r(jnp.asarray(1j * gv.gcart[:, i] * (rho_g + ctx.rho_core_g)), fft_index, dims)
-            ).real
-            for i in range(3)
-        ]
-        sigma = grad[0] ** 2 + grad[1] ** 2 + grad[2] ** 2
-        out = xc.evaluate(jnp.asarray(rho_xc.ravel()), jnp.asarray(sigma.ravel()))
-        vxc_r = np.asarray(out["v"]).reshape(dims)
-        exc_r = np.asarray(out["e"]).reshape(dims) / np.maximum(rho_xc, 1e-25)
-        # gradient correction: V -= div(2 vsigma grad rho)
-        vs = np.asarray(out["vsigma"]).reshape(dims)
-        div = np.zeros(dims)
-        for i in range(3):
-            t_g = np.asarray(
-                r_to_g(jnp.asarray((2.0 * vs * grad[i]).astype(np.complex128)), fft_index, dims)
+    if polarized:
+        mag_r = _to_r(ctx, mag_g)
+        # clip |m| <= rho_xc (reference density guard) and split channels;
+        # the core charge is unpolarized and split evenly
+        rho_xc = np.maximum(rho_r + rho_core_r, 1e-20)
+        m = np.clip(mag_r, -rho_xc, rho_xc)
+        n_up = 0.5 * (rho_xc + m)
+        n_dn = 0.5 * (rho_xc - m)
+        if xc.is_gga:
+            gu = _gradient_r(ctx, 0.5 * (rho_g + ctx.rho_core_g + mag_g))
+            gd = _gradient_r(ctx, 0.5 * (rho_g + ctx.rho_core_g - mag_g))
+            suu = sum(g * g for g in gu)
+            sdd = sum(g * g for g in gd)
+            sud = sum(a * b for a, b in zip(gu, gd))
+            out = xc.evaluate_polarized(
+                jnp.asarray(n_up.ravel()), jnp.asarray(n_dn.ravel()),
+                jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()), jnp.asarray(sdd.ravel()),
             )
-            div += np.asarray(
-                g_to_r(jnp.asarray(1j * gv.gcart[:, i] * t_g), fft_index, dims)
-            ).real
-        vxc_r = vxc_r - div
+            v_up = np.asarray(out["v_up"]).reshape(dims)
+            v_dn = np.asarray(out["v_dn"]).reshape(dims)
+            vsuu = np.asarray(out["vsigma_uu"]).reshape(dims)
+            vsud = np.asarray(out["vsigma_ud"]).reshape(dims)
+            vsdd = np.asarray(out["vsigma_dd"]).reshape(dims)
+            # v_s -= div(2 vs_ss grad n_s + vs_sd grad n_other)
+            div_u = _to_r(ctx, _divergence_g(ctx, [2 * vsuu * a + vsud * b for a, b in zip(gu, gd)]))
+            div_d = _to_r(ctx, _divergence_g(ctx, [2 * vsdd * b + vsud * a for a, b in zip(gu, gd)]))
+            v_up = v_up - div_u
+            v_dn = v_dn - div_d
+        else:
+            out = xc.evaluate_polarized(jnp.asarray(n_up.ravel()), jnp.asarray(n_dn.ravel()))
+            v_up = np.asarray(out["v_up"]).reshape(dims)
+            v_dn = np.asarray(out["v_dn"]).reshape(dims)
+        e_r = np.asarray(out["e"]).reshape(dims)
+        vxc_r = 0.5 * (v_up + v_dn)
+        bz_r = 0.5 * (v_up - v_dn)
     else:
-        out = xc.evaluate(jnp.asarray(rho_xc.ravel()))
-        vxc_r = np.asarray(out["v"]).reshape(dims)
-        exc_r = np.asarray(out["e"]).reshape(dims) / np.maximum(rho_xc, 1e-25)
+        rho_xc = np.maximum(rho_r + rho_core_r, 0.0)
+        if xc.is_gga:
+            g = _gradient_r(ctx, rho_g + ctx.rho_core_g)
+            sigma = g[0] ** 2 + g[1] ** 2 + g[2] ** 2
+            out = xc.evaluate(jnp.asarray(rho_xc.ravel()), jnp.asarray(sigma.ravel()))
+            vxc_r = np.asarray(out["v"]).reshape(dims)
+            vs = np.asarray(out["vsigma"]).reshape(dims)
+            vxc_r = vxc_r - _to_r(ctx, _divergence_g(ctx, [2.0 * vs * gi for gi in g]))
+        else:
+            out = xc.evaluate(jnp.asarray(rho_xc.ravel()))
+            vxc_r = np.asarray(out["v"]).reshape(dims)
+        e_r = np.asarray(out["e"]).reshape(dims)
+        bz_r = None
 
-    # assemble V_eff(G) = V_loc(G) + V_H(G) + V_xc(G)
-    vxc_g = np.asarray(r_to_g(jnp.asarray(vxc_r.astype(np.complex128)), fft_index, dims))
+    exc_r = e_r / np.maximum(rho_xc, 1e-25)
+
+    vxc_g = _to_g(ctx, vxc_r)
     veff_g = ctx.vloc_g + vha_g + vxc_g
-    if ctx.symmetry is not None and ctx.symmetry.num_ops > 1:
+    bz_g = _to_g(ctx, bz_r) if polarized else None
+    if ctx.symmetry is not None and ctx.symmetry.num_ops > 1 and ctx.cfg.parameters.use_symmetry:
         veff_g = symmetrize_pw(ctx, veff_g)
+        if bz_g is not None:
+            bz_g = symmetrize_pw(ctx, bz_g)
 
-    # map to coarse box for the local operator
-    veff_g_coarse = veff_g[ctx.coarse_to_fine]
-    veff_r_coarse = np.asarray(
-        g_to_r(
-            jnp.asarray(veff_g_coarse),
-            jnp.asarray(ctx.gvec_coarse.fft_index),
-            ctx.fft_coarse.dims,
-        )
-    ).real
+    # per-spin potentials on the coarse box for the local operator
+    def to_coarse(f_g):
+        return np.asarray(
+            g_to_r(
+                jnp.asarray(f_g[ctx.coarse_to_fine]),
+                jnp.asarray(ctx.gvec_coarse.fft_index),
+                ctx.fft_coarse.dims,
+            )
+        ).real
 
-    # energy integrals (reference names; all with valence rho except exc)
-    vloc_r = np.asarray(g_to_r(jnp.asarray(ctx.vloc_g), fft_index, dims)).real
-    vha_r = np.asarray(g_to_r(jnp.asarray(vha_g), fft_index, dims)).real
-    veff_r = np.asarray(g_to_r(jnp.asarray(veff_g), fft_index, dims)).real
+    if polarized:
+        v_r = to_coarse(veff_g)
+        b_r = to_coarse(bz_g)
+        veff_r_coarse = np.stack([v_r + b_r, v_r - b_r])
+    else:
+        veff_r_coarse = to_coarse(veff_g)[None]
+
+    # energy integrals (reference names; valence rho except exc)
+    vloc_r = _to_r(ctx, ctx.vloc_g)
+    vha_r = _to_r(ctx, vha_g)
+    veff_r_fine = _to_r(ctx, veff_g)
     energies = {
         "vha": _inner_rr(ctx, rho_r, vha_r),
         "vxc": _inner_rr(ctx, rho_r, vxc_r),
         "vloc": _inner_rr(ctx, rho_r, vloc_r),
-        "veff": _inner_rr(ctx, rho_r, veff_r),
+        "veff": _inner_rr(ctx, rho_r, veff_r_fine),
         "exc": _inner_rr(ctx, rho_r + rho_core_r, exc_r),
+        "bxc": _inner_rr(ctx, mag_r, _to_r(ctx, bz_g)) if polarized else 0.0,
     }
     return PotentialResult(
         veff_g=veff_g,
+        bz_g=bz_g,
         veff_r_coarse=veff_r_coarse,
         vha_g=vha_g,
-        vxc_r=vxc_r,
-        exc_r=exc_r,
+        vxc_g=vxc_g,
         energies=energies,
     )

@@ -20,6 +20,7 @@ from sirius_tpu.context import SimulationContext
 from sirius_tpu.dft.density import (
     generate_density_g,
     initial_density_g,
+    initial_magnetization_g,
     rho_real_space,
     symmetrize_pw,
 )
@@ -31,6 +32,7 @@ from sirius_tpu.ops.atomic import atomic_orbitals
 from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
+from sirius_tpu.utils.profiler import counters, profile, timer_report
 
 
 @jax.jit
@@ -83,10 +85,20 @@ def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
     return jnp.asarray(psi)
 
 
-def run_scf(cfg: Config, base_dir: str = ".") -> dict:
+def run_scf(
+    cfg: Config,
+    base_dir: str = ".",
+    restart_from: str | None = None,
+    save_to: str | None = None,
+    ctx: SimulationContext | None = None,
+) -> dict:
     t0 = time.time()
+    from sirius_tpu.utils.profiler import reset_timers
+
+    reset_timers()
     p = cfg.parameters
-    ctx = SimulationContext.create(cfg, base_dir)
+    if ctx is None:
+        ctx = SimulationContext.create(cfg, base_dir)
     xc = XCFunctional(p.xc_functionals)
     nk, ns, nb = ctx.gkvec.num_kpoints, ctx.num_spins, ctx.num_bands
     nel = ctx.unit_cell.num_valence_electrons - p.extra_charge
@@ -96,54 +108,83 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
             f"num_bands={nb} cannot hold {nel} electrons "
             f"(max {nb * ctx.max_occupancy * ctx.num_spins})"
         )
-    if ctx.num_mag_dims != 0:
-        raise NotImplementedError("magnetism lands after the ultrasoft layer")
+    if ctx.num_mag_dims == 3:
+        raise NotImplementedError("non-collinear magnetism is not implemented yet")
     if any(t.pseudo_type == "PAW" for t in ctx.unit_cell.atom_types):
         raise NotImplementedError("PAW on-site terms are not implemented yet")
+    polarized = ctx.num_mag_dims == 1
 
     rho_g = initial_density_g(ctx)
-    pot = generate_potential(ctx, rho_g, xc)
+    mag_g = initial_magnetization_g(ctx) if polarized else None
+    if restart_from:
+        from sirius_tpu.io.checkpoint import load_state
+
+        state = load_state(restart_from, ctx)
+        rho_g = state["rho_g"]
+        if polarized:
+            mag_g = state.get("mag_g", mag_g)
+    pot = generate_potential(ctx, rho_g, xc, mag_g)
     psi = _initial_subspace(ctx)
-    mixer = Mixer(cfg.mixer, ctx.gvec.glen2)
+    mixer = Mixer(cfg.mixer, ctx.gvec.glen2, num_components=2 if polarized else 1)
     # constant device tables, uploaded once (not per iteration)
     beta_dev = [jnp.asarray(ctx.beta.beta_gk[ik]) for ik in range(nk)]
     do_symmetrize = (
         p.use_symmetry and ctx.symmetry is not None and ctx.symmetry.num_ops > 1
     )
 
+    def pack(r, m):
+        return np.concatenate([r, m]) if polarized else r
+
+    def unpack(x):
+        return (x[: ctx.gvec.num_gvec], x[ctx.gvec.num_gvec :]) if polarized else (x, None)
+
+    x_mix = pack(rho_g, mag_g)
+
     evals = np.zeros((nk, ns, nb))
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
     etot_history, rms_history = [], []
-    e_prev, converged, rms = None, False, 0.0
+    e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
     num_iter_done = 0
     itsol = cfg.iterative_solver
 
     for it in range(p.num_dft_iter):
-        # --- band solve per k (warm start) ---
-        if ctx.aug is not None:
-            d_full = d_operator(ctx.unit_cell, ctx.gvec, ctx.aug, pot.veff_g, ctx.beta)
-        else:
-            d_full = ctx.beta.dion
-        new_psi = []
-        for ik in range(nk):
-            params = make_hk_params(ctx, ik, pot.veff_r_coarse, d_full)
-            v0 = float(np.real(pot.veff_g[0]))
-            h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_full)
-            per_spin = []
-            for ispn in range(ns):
-                ev, x, rn = davidson(
-                    apply_h_s,
-                    params,
-                    psi[ik, ispn],
-                    jnp.asarray(h_diag),
-                    jnp.asarray(o_diag),
-                    jnp.asarray(ctx.gkvec.mask[ik]),
-                    num_steps=itsol.num_steps,
-                    res_tol=itsol.residual_tolerance,
+        # --- band solve per (k, spin) (warm start) ---
+        d_by_spin = []
+        for ispn in range(ns):
+            if ctx.aug is not None:
+                vs_g = pot.veff_g + (pot.bz_g if ispn == 0 else -pot.bz_g) if polarized else pot.veff_g
+                d_by_spin.append(
+                    d_operator(ctx.unit_cell, ctx.gvec, ctx.aug, vs_g, ctx.beta)
                 )
-                evals[ik, ispn] = np.asarray(ev)
-                per_spin.append(x)
-            new_psi.append(jnp.stack(per_spin))
+            else:
+                d_by_spin.append(ctx.beta.dion)
+        new_psi = []
+        with profile("scf::band_solve"):
+            for ik in range(nk):
+                per_spin = []
+                for ispn in range(ns):
+                    params = make_hk_params(
+                        ctx, ik, pot.veff_r_coarse[ispn], d_by_spin[ispn]
+                    )
+                    v0 = float(np.real(pot.veff_g[0]))
+                    h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
+                    ev, x, rn = davidson(
+                        apply_h_s,
+                        params,
+                        psi[ik, ispn],
+                        jnp.asarray(h_diag),
+                        jnp.asarray(o_diag),
+                        jnp.asarray(ctx.gkvec.mask[ik]),
+                        num_steps=itsol.num_steps,
+                        res_tol=itsol.residual_tolerance,
+                    )
+                    evals[ik, ispn] = np.asarray(ev)
+                    per_spin.append(x)
+                new_psi.append(jnp.stack(per_spin))
+            # H*psi application count: davidson applies H to the initial
+            # block once and to the 3nb subspace each step (reference
+            # num_loc_op_applied counter)
+            counters["num_loc_op_applied"] += nk * ns * nb * (2 + 3 * itsol.num_steps)
         psi = jnp.stack(new_psi)
 
         # --- occupations ---
@@ -157,34 +198,64 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
         )
         occ_np = np.asarray(occ)
 
-        # --- density ---
-        rho_new = generate_density_g(ctx, psi, occ_np, symmetrize=False)
+        # --- density (per spin, then charge/magnetization assembly) ---
+        with profile("scf::density"):
+            rho_spin = generate_density_g(ctx, psi, occ_np)
+        dm_blocks_by_spin = []
         if ctx.aug is not None:
-            dm_full = np.zeros(
-                (ctx.beta.num_beta_total, ctx.beta.num_beta_total), dtype=np.complex128
-            )
-            for ik in range(nk):
-                ow = jnp.asarray(occ_np[ik] * ctx.kweights[ik])
-                dm_full += np.asarray(_density_matrix_k(beta_dev[ik], psi[ik], ow))
-            dm_blocks = [
-                dm_full[off : off + nbf, off : off + nbf]
-                for _, off, nbf in ctx.beta.atom_blocks(ctx.unit_cell)
-            ]
-            rho_new = rho_new + rho_aug_g(ctx.unit_cell, ctx.gvec, ctx.aug, dm_blocks)
+            for ispn in range(ns):
+                dm_full = np.zeros(
+                    (ctx.beta.num_beta_total, ctx.beta.num_beta_total),
+                    dtype=np.complex128,
+                )
+                for ik in range(nk):
+                    ow = jnp.asarray(occ_np[ik, ispn : ispn + 1] * ctx.kweights[ik])
+                    dm_full += np.asarray(
+                        _density_matrix_k(beta_dev[ik], psi[ik, ispn : ispn + 1], ow)
+                    )
+                dm_blocks = [
+                    dm_full[off : off + nbf, off : off + nbf]
+                    for _, off, nbf in ctx.beta.atom_blocks(ctx.unit_cell)
+                ]
+                dm_blocks_by_spin.append(dm_blocks)
+                rho_spin[ispn] += rho_aug_g(ctx.unit_cell, ctx.gvec, ctx.aug, dm_blocks)
+        rho_new = rho_spin.sum(axis=0)
+        mag_new = rho_spin[0] - rho_spin[1] if polarized else None
         if do_symmetrize:
             rho_new = symmetrize_pw(ctx, rho_new)
-        rms = mixer.rms(rho_g, rho_new)
-        rho_mixed = mixer.mix(rho_g, rho_new)
-        rho_g = rho_mixed
+            if polarized:
+                mag_new = symmetrize_pw(ctx, mag_new)
+        x_new = pack(rho_new, mag_new)
+        rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
+        rms = mixer.rms(x_mix, x_new)
+        x_mix = mixer.mix(x_mix, x_new)
+        rho_g, mag_g = unpack(x_mix)
+
+        # first-order (Harris-like) correction: E_pot[rho_out] under the new
+        # vs old potential (reference dft_ground_state.cpp:245,320-322)
+        def _epot(r_out, m_out, p_):
+            e = float(np.real(np.vdot(r_out, p_.veff_g))) * ctx.unit_cell.omega
+            if polarized and p_.bz_g is not None and m_out is not None:
+                e += float(np.real(np.vdot(m_out, p_.bz_g))) * ctx.unit_cell.omega
+            return e
+
+        e1 = _epot(rho_new, mag_new, pot)
 
         # --- potential + energies ---
-        pot = generate_potential(ctx, rho_g, xc)
+        with profile("scf::potential"):
+            pot = generate_potential(ctx, rho_g, xc, mag_g)
+        scf_correction = (
+            _epot(rho_new, mag_new, pot) - e1 if p.use_scf_correction else 0.0
+        )
         eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
         e = pot.energies
         e_total = (
-            eval_sum - e["vxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+            eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+            + scf_correction
         )
-        etot_history.append(e_total)
+        # reference etot_history records the free energy (dft_ground_state
+        # etot_hist; verified against verification/test23 and test01 outputs)
+        etot_history.append(e_total + float(entropy_sum))
         rms_history.append(rms)
         num_iter_done = it + 1
 
@@ -200,7 +271,10 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
     rho_r = rho_real_space(ctx, rho_g)
     e = pot.energies
     eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
-    e_total = eval_sum - e["vxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+    e_total = (
+        eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+        + scf_correction
+    )
     result = {
         "converged": converged,
         "num_scf_iterations": num_iter_done,
@@ -214,20 +288,41 @@ def run_scf(cfg: Config, base_dir: str = ".") -> dict:
             "total": e_total,
             "free": e_total + float(entropy_sum),
             "eval_sum": eval_sum,
-            "kin": eval_sum - e["veff"],
+            "kin": eval_sum - e["veff"] - e["bxc"],
             "veff": e["veff"],
             "vha": e["vha"],
             "vxc": e["vxc"],
             "vloc": e["vloc"],
             "exc": e["exc"],
-            "bxc": 0.0,
+            "bxc": e["bxc"],
             "ewald": ctx.e_ewald,
             "entropy_sum": float(entropy_sum),
-            "scf_correction": 0.0,
+            "scf_correction": scf_correction,
         },
         "band_energies": evals.tolist(),
         "band_occupancies": occ_np.tolist(),
+        "counters": dict(counters),
+        "timers": timer_report(),
     }
+    if polarized:
+        result["magnetisation"] = {
+            "total": [0.0, 0.0, float(np.real(mag_g[0]) * ctx.unit_cell.omega)]
+        }
+    if cfg.control.print_forces and num_iter_done > 0:
+        from sirius_tpu.dft.forces import total_forces
+
+        fterms = total_forces(
+            ctx, rho_g, pot.vxc_g, pot.veff_g, pot.bz_g, psi, occ_np, evals,
+            d_by_spin, dm_blocks_by_spin, rho_resid_g=rho_resid_g,
+        )
+        result["forces"] = fterms["total"].tolist()
+    if save_to:
+        from sirius_tpu.io.checkpoint import save_state
+
+        save_state(
+            save_to, ctx, rho_g, mag_g, pot.veff_g, pot.bz_g,
+            np.asarray(psi), evals, occ_np,
+        )
     return result
 
 

@@ -1,0 +1,1 @@
+from sirius_tpu.io.checkpoint import save_state, load_state

@@ -1,0 +1,81 @@
+"""HDF5 checkpoint/restart (reference: sirius.h5 state file —
+Density::save/load, Potential::save/load writing PW coefficient arrays,
+density.hpp:603-630; task ground_state_restart reloads rho/V and re-runs
+SCF, sirius.scf.cpp:147-155).
+
+Layout:
+  /meta: miller indices + lattice (to validate compatibility on load)
+  /density/rho_g, /density/mag_g (optional)
+  /potential/veff_g, /potential/bz_g (optional)
+  /kset/psi, /kset/band_energies, /kset/band_occupancies (optional)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def save_state(
+    path: str,
+    ctx,
+    rho_g: np.ndarray,
+    mag_g: np.ndarray | None = None,
+    veff_g: np.ndarray | None = None,
+    bz_g: np.ndarray | None = None,
+    psi: np.ndarray | None = None,
+    band_energies: np.ndarray | None = None,
+    band_occupancies: np.ndarray | None = None,
+) -> None:
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        meta = f.create_group("meta")
+        meta.create_dataset("millers", data=ctx.gvec.millers)
+        meta.create_dataset("lattice", data=ctx.unit_cell.lattice)
+        meta.attrs["num_gvec"] = ctx.gvec.num_gvec
+        den = f.create_group("density")
+        den.create_dataset("rho_g", data=np.asarray(rho_g))
+        if mag_g is not None:
+            den.create_dataset("mag_g", data=np.asarray(mag_g))
+        if veff_g is not None:
+            pot = f.create_group("potential")
+            pot.create_dataset("veff_g", data=np.asarray(veff_g))
+            if bz_g is not None:
+                pot.create_dataset("bz_g", data=np.asarray(bz_g))
+        if psi is not None:
+            ks = f.create_group("kset")
+            ks.create_dataset("psi", data=np.asarray(psi))
+            if band_energies is not None:
+                ks.create_dataset("band_energies", data=np.asarray(band_energies))
+            if band_occupancies is not None:
+                ks.create_dataset("band_occupancies", data=np.asarray(band_occupancies))
+
+
+def load_state(path: str, ctx) -> dict:
+    import h5py
+
+    out: dict = {}
+    with h5py.File(path, "r") as f:
+        mill = f["meta/millers"][...]
+        if mill.shape != ctx.gvec.millers.shape or not np.array_equal(
+            mill, ctx.gvec.millers
+        ):
+            raise ValueError(
+                "checkpoint G-set does not match the current context "
+                "(different cutoff/lattice)"
+            )
+        if not np.allclose(f["meta/lattice"][...], ctx.unit_cell.lattice, atol=1e-10):
+            raise ValueError("checkpoint lattice does not match")
+        out["rho_g"] = f["density/rho_g"][...]
+        if "mag_g" in f["density"]:
+            out["mag_g"] = f["density/mag_g"][...]
+        if "potential" in f:
+            out["veff_g"] = f["potential/veff_g"][...]
+            if "bz_g" in f["potential"]:
+                out["bz_g"] = f["potential/bz_g"][...]
+        if "kset" in f:
+            out["psi"] = f["kset/psi"][...]
+            for k in ("band_energies", "band_occupancies"):
+                if k in f["kset"]:
+                    out[k] = f["kset"][k][...]
+    return out

@@ -55,31 +55,34 @@ def synthetic_silicon_context(
     num_bands: int | None = None,
     ultrasoft: bool = True,
     use_symmetry: bool = True,
+    positions: np.ndarray | None = None,
+    extra_params: dict | None = None,
 ) -> SimulationContext:
     """Diamond-Si-like 2-atom cell with the synthetic species."""
     import sirius_tpu.crystal.unit_cell as ucm
 
-    cfg = Config.from_dict(
-        {
-            "parameters": {
-                "gk_cutoff": gk_cutoff,
-                "pw_cutoff": pw_cutoff,
-                "ngridk": list(ngridk),
-                "use_symmetry": use_symmetry,
-                "num_bands": num_bands if num_bands else -1,
-                "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
-                "smearing_width": 0.025,
-            }
-        }
-    )
+    params = {
+        "gk_cutoff": gk_cutoff,
+        "pw_cutoff": pw_cutoff,
+        "ngridk": list(ngridk),
+        "use_symmetry": use_symmetry,
+        "num_bands": num_bands if num_bands else -1,
+        "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+        "smearing_width": 0.025,
+    }
+    if extra_params:
+        params.update(extra_params)
+    cfg = Config.from_dict({"parameters": params})
     a = 10.26
     lattice = a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
     t = synthetic_silicon_type(ultrasoft=ultrasoft)
+    if positions is None:
+        positions = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]])
     uc = ucm.UnitCell(
         lattice=lattice,
         atom_types=[t],
         type_of_atom=np.array([0, 0], dtype=np.int32),
-        positions=np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]]),
+        positions=np.asarray(positions, dtype=np.float64),
         moments=np.zeros((2, 3)),
     )
     # SimulationContext.create reads species from files; build the parts

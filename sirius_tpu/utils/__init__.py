@@ -1,0 +1,1 @@
+from sirius_tpu.utils.profiler import profile, timer_report, reset_timers, counters

@@ -1,0 +1,51 @@
+"""Lightweight hierarchical profiler + work counters.
+
+Replaces the reference's vendored rt_graph timers (src/core/rt_graph.hpp,
+PROFILE macros in core/profiler.hpp:37-61) and the self-reported work
+counters (evp_work_count / num_loc_op_applied, davidson.hpp:834,
+sirius.scf.cpp:232-234). Device-side profiling composes with
+jax.profiler traces; this registry covers the host-orchestrated spans and
+produces the timers.json-style summary the reference emits at finalize.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+_STACK: list[str] = []
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+counters: dict[str, float] = defaultdict(float)
+
+
+@contextlib.contextmanager
+def profile(name: str):
+    """Nested scoped timer: with profile("scf::band_solve"): ..."""
+    _STACK.append(name)
+    full = "/".join(_STACK)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _TIMINGS[full].append(time.perf_counter() - t0)
+        _STACK.pop()
+
+
+def reset_timers() -> None:
+    _TIMINGS.clear()
+    counters.clear()
+
+
+def timer_report() -> dict:
+    """{name: {count, total, avg, min, max}} sorted by total time."""
+    out = {}
+    for name, ts in sorted(_TIMINGS.items(), key=lambda kv: -sum(kv[1])):
+        out[name] = {
+            "count": len(ts),
+            "total": sum(ts),
+            "avg": sum(ts) / len(ts),
+            "min": min(ts),
+            "max": max(ts),
+        }
+    return out
