@@ -1,0 +1,279 @@
+"""Stress tensor for the PP-PW method.
+
+Reference: src/geometry/stress.cpp — sigma = kin + har + ewald + vloc +
+nonloc + us + xc + core (stress.hpp:96-114), symmetrized.
+
+Convention: sigma_ab = (1/Omega) dF/d eps_ab for r -> (1+eps) r at frozen
+wave-function PW coefficients and occupations. Under that strain the
+reciprocal vectors move as B -> B (1+eps)^{-1}, Miller indices / structure
+phases e^{-2 pi i m.x} are invariant, the valence density coefficients
+rescale as rho(G) -> rho(G) Omega0/Omega, and atom-attached form-factor
+fields carry their 4pi/Omega prefactor.
+
+Implementation: each term's frozen-coefficient energy functional is written
+exactly for a strained lattice and differentiated by central differences in
+the 6 independent strain components (O(h^2), h = 1e-5). The reference builds
+closed-form d/dq radial tables instead (radial_integrals<true>,
+beta_projectors_strain_deriv.hpp) — same derivative, different evaluation;
+the whole tensor is validated against full-SCF strained-lattice finite
+differences in tests/test_stress.py. Ultrasoft augmentation stress is not
+yet included (the D-operator's own strain response); NC-accurate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sirius_tpu.context import SimulationContext
+from sirius_tpu.core.radial import RadialIntegralTable
+from sirius_tpu.dft.ewald import ewald_energy
+from sirius_tpu.dft.radial_tables import (
+    rho_core_form_factor,
+    structure_factors,
+    vloc_form_factor,
+)
+
+_H = 1e-5
+
+
+def _strained(lattice: np.ndarray, eps: np.ndarray) -> np.ndarray:
+    return lattice @ (np.eye(3) + eps).T  # rows a_i -> (1+eps) a_i
+
+
+def _ff_table(ff_fn, t, qmax: float):
+    """Dense spline table of a form factor, evaluable at arbitrary q."""
+    from scipy.interpolate import CubicSpline
+
+    q = np.linspace(0.0, qmax, max(256, int(qmax * 24)))
+    return CubicSpline(q, np.asarray(ff_fn(t, q)))
+
+
+class StressCalculator:
+    """Per-term sigma via central differences of exact strained functionals."""
+
+    def __init__(self, ctx: SimulationContext, xc, h: float = _H):
+        self.ctx = ctx
+        self.xc = xc
+        self.h = h
+        uc = ctx.unit_cell
+        self.sfact = structure_factors(uc, ctx.gvec)
+        qmax_fine = ctx.cfg.parameters.pw_cutoff * 1.05
+        qmax_gk = ctx.cfg.parameters.gk_cutoff * 1.05
+        self.vloc_tab = [_ff_table(vloc_form_factor, t, qmax_fine) for t in uc.atom_types]
+        self.core_tab = [
+            _ff_table(rho_core_form_factor, t, qmax_fine) if t.rho_core is not None else None
+            for t in uc.atom_types
+        ]
+        from sirius_tpu.ops.beta import beta_radial_table
+
+        self.beta_tab = [beta_radial_table(t, qmax_gk) for t in uc.atom_types]
+
+    # --- strained geometric tables -------------------------------------
+    def _recip(self, eps):
+        return 2.0 * np.pi * np.linalg.inv(_strained(self.ctx.unit_cell.lattice, eps)).T
+
+    def _gcart(self, eps):
+        return self.ctx.gvec.millers @ self._recip(eps)
+
+    def _gkcart(self, eps):
+        b = self._recip(eps)
+        mk = self.ctx.gkvec.millers + self.ctx.gkvec.kpoints[:, None, :]
+        return (mk @ b) * self.ctx.gkvec.mask[..., None]
+
+    def _omega(self, eps):
+        return float(abs(np.linalg.det(_strained(self.ctx.unit_cell.lattice, eps))))
+
+    # --- frozen-coefficient energy functionals -------------------------
+    def e_kinetic(self, eps, psi, occ_w):
+        gk = self._gkcart(eps)
+        e = 0.0
+        for ik in range(self.ctx.gkvec.num_kpoints):
+            ek = 0.5 * np.sum(gk[ik] ** 2, axis=-1)
+            for ispn in range(psi.shape[1]):
+                dens = np.einsum("b,bg->g", occ_w[ik, ispn], np.abs(np.asarray(psi[ik, ispn])) ** 2)
+                e += float(dens @ ek)
+        return e
+
+    def e_hartree(self, eps, rho_g):
+        g2 = np.sum(self._gcart(eps) ** 2, axis=1)[1:]
+        om0 = self.ctx.unit_cell.omega
+        return 2.0 * np.pi * om0**2 / self._omega(eps) * float(
+            np.sum(np.abs(rho_g[1:]) ** 2 / g2)
+        )
+
+    def e_vloc(self, eps, rho_g):
+        glen = np.sqrt(np.sum(self._gcart(eps) ** 2, axis=1))
+        om0 = self.ctx.unit_cell.omega
+        acc = 0.0
+        for it in range(len(self.ctx.unit_cell.atom_types)):
+            ff = self.vloc_tab[it](glen)
+            acc += float(np.real(np.vdot(rho_g, ff * np.conj(self.sfact[it]))))
+        return 4.0 * np.pi * om0 / self._omega(eps) * acc
+
+    def e_ewald(self, eps):
+        uc = self.ctx.unit_cell
+        z = np.asarray([uc.atom_types[t].zn for t in uc.type_of_atom])
+        return ewald_energy(
+            _strained(uc.lattice, eps), uc.positions, z,
+            self._gcart(eps), self.ctx.gvec.millers, self.ctx.cfg.parameters.pw_cutoff,
+        )
+
+    def e_xc(self, eps, rho_r0, mag_r0):
+        """E_xc[(rho_val*Om0/Om + rho_core(eps))] * Om/N; core rebuilt from
+        its strained form factors (one FFT per evaluation)."""
+        import jax.numpy as jnp
+
+        from sirius_tpu.core.fftgrid import g_to_r
+
+        ctx = self.ctx
+        om0 = ctx.unit_cell.omega
+        om = self._omega(eps)
+        glen = np.sqrt(np.sum(self._gcart(eps) ** 2, axis=1))
+        core_g = np.zeros(ctx.gvec.num_gvec, dtype=np.complex128)
+        for it in range(len(ctx.unit_cell.atom_types)):
+            if self.core_tab[it] is not None:
+                core_g += self.core_tab[it](glen) * np.conj(self.sfact[it])
+        core_g *= 4.0 * np.pi / om
+        fidx = jnp.asarray(ctx.gvec.fft_index)
+        dims = ctx.gvec.fft.dims
+
+        def to_r(f_g):
+            return np.asarray(g_to_r(jnp.asarray(f_g), fidx, dims)).real
+
+        core_r = to_r(core_g) if np.any(core_g) else 0.0
+        scale = om0 / om
+        n = rho_r0.size
+
+        def sigma_of(total_g):
+            """|grad f|^2 on the strained lattice (i G_s f(G))."""
+            gc = self._gcart(eps)
+            grads = [to_r(1j * gc[:, i] * total_g) for i in range(3)]
+            return grads
+
+        if mag_r0 is None:
+            rho = np.maximum(rho_r0 * scale + core_r, 1e-25)
+            if self.xc.is_gga:
+                # strained gradient of (scaled valence + strained core)
+                tot_g = self._rho_g_ref * scale + core_g
+                g = sigma_of(tot_g)
+                sig = g[0] ** 2 + g[1] ** 2 + g[2] ** 2
+                e = np.asarray(
+                    self.xc.evaluate(jnp.asarray(rho.ravel()), jnp.asarray(sig.ravel()))["e"]
+                )
+            else:
+                e = np.asarray(self.xc.evaluate(jnp.asarray(rho.ravel()))["e"])
+        else:
+            tot = np.maximum(rho_r0 * scale + core_r, 1e-25)
+            m = np.clip(mag_r0 * scale, -tot, tot)
+            if self.xc.is_gga:
+                up_g = 0.5 * (self._rho_g_ref * scale + core_g + self._mag_g_ref * scale)
+                dn_g = 0.5 * (self._rho_g_ref * scale + core_g - self._mag_g_ref * scale)
+                gu = sigma_of(up_g)
+                gd = sigma_of(dn_g)
+                suu = sum(x * x for x in gu)
+                sdd = sum(x * x for x in gd)
+                sud = sum(a * b for a, b in zip(gu, gd))
+                e = np.asarray(
+                    self.xc.evaluate_polarized(
+                        jnp.asarray(((tot + m) / 2).ravel()),
+                        jnp.asarray(((tot - m) / 2).ravel()),
+                        jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()),
+                        jnp.asarray(sdd.ravel()),
+                    )["e"]
+                )
+            else:
+                e = np.asarray(
+                    self.xc.evaluate_polarized(
+                        jnp.asarray(((tot + m) / 2).ravel()), jnp.asarray(((tot - m) / 2).ravel())
+                    )["e"]
+                )
+        return float(e.sum()) * om / n
+
+    def e_nonloc(self, eps, psi, occ_w, evals, d_by_spin):
+        """Non-local energy with strained projector tables; includes the
+        -eps <psi|Q|psi> orthogonality term for ultrasoft."""
+        from sirius_tpu.core.sht import lm_index, ylm_real
+
+        ctx = self.ctx
+        uc = ctx.unit_cell
+        if ctx.beta.num_beta_total == 0:
+            return 0.0
+        gk = self._gkcart(eps)
+        qlen = np.linalg.norm(gk, axis=-1)
+        lmax = max(t.lmax_beta for t in uc.atom_types if t.num_beta)
+        rhat = np.where(
+            qlen[..., None] > 1e-30, gk / np.maximum(qlen, 1e-30)[..., None], np.array([0.0, 0, 1.0])
+        )
+        rlm = ylm_real(lmax, rhat)
+        pref = 4.0 * np.pi / np.sqrt(self._omega(eps))
+        qmat = ctx.beta.qmat
+        e = 0.0
+        nk = ctx.gkvec.num_kpoints
+        for ik in range(nk):
+            ngk = int(ctx.gkvec.num_gk[ik])
+            beta_k = np.zeros((ctx.beta.num_beta_total, ngk), dtype=np.complex128)
+            mk = ctx.gkvec.millers[ik, :ngk] + ctx.gkvec.kpoints[ik][None, :]
+            for ia, off, nbf in ctx.beta.atom_blocks(uc):
+                t = uc.atom_types[uc.type_of_atom[ia]]
+                if not t.num_beta:
+                    continue
+                ri = self.beta_tab[uc.type_of_atom[ia]](qlen[ik, :ngk])
+                phase = np.exp(-2j * np.pi * (mk @ uc.positions[ia]))
+                idxrf, ls, ms = t.beta_lm_table()
+                for xi in range(nbf):
+                    l, m_, ir = int(ls[xi]), int(ms[xi]), int(idxrf[xi])
+                    beta_k[off + xi] = (
+                        pref * (-1j) ** l * rlm[ik, :ngk, lm_index(l, m_)] * ri[ir] * phase
+                    )
+            for ispn in range(psi.shape[1]):
+                ps = np.asarray(psi[ik, ispn])[:, :ngk]
+                bp = np.conj(beta_k) @ ps.T  # (nbeta, nb)
+                f = occ_w[ik, ispn]
+                d = np.einsum("xb,xy,yb->b", np.conj(bp), d_by_spin[ispn], bp).real
+                e += float(np.sum(f * d))
+                if qmat is not None:
+                    o = np.einsum("xb,xy,yb->b", np.conj(bp), qmat, bp).real
+                    e -= float(np.sum(f * evals[ik, ispn] * o))
+        return e
+
+    # --- assembly -------------------------------------------------------
+    def compute(self, rho_g, mag_g, rho_r, mag_r, psi, occ, evals, d_by_spin) -> dict:
+        ctx = self.ctx
+        self._rho_g_ref = rho_g
+        self._mag_g_ref = mag_g
+        occ_w = occ * ctx.gkvec.weights[:, None, None]
+        terms = {
+            "kin": lambda e: self.e_kinetic(e, psi, occ_w),
+            "har": lambda e: self.e_hartree(e, rho_g),
+            "vloc": lambda e: self.e_vloc(e, rho_g),
+            "ewald": lambda e: self.e_ewald(e),
+            "xc": lambda e: self.e_xc(e, rho_r, mag_r),
+            "nonloc": lambda e: self.e_nonloc(e, psi, occ_w, evals, d_by_spin),
+        }
+        out = {}
+        om = ctx.unit_cell.omega
+        h = self.h
+        for name, fn in terms.items():
+            s = np.zeros((3, 3))
+            for a in range(3):
+                for b in range(a, 3):
+                    eps = np.zeros((3, 3))
+                    eps[a, b] += h
+                    eps[b, a] += h
+                    de = (fn(eps) - fn(-eps)) / (2 * h)
+                    # symmetric-strain derivative gives sigma_ab + sigma_ba
+                    s[a, b] = s[b, a] = de / 2.0
+            out[name] = s / om
+        total = sum(out.values())
+        out["total"] = symmetrize_stress(ctx, total)
+        return out
+
+
+def symmetrize_stress(ctx: SimulationContext, s: np.ndarray) -> np.ndarray:
+    if ctx.symmetry is None or ctx.symmetry.num_ops <= 1:
+        return 0.5 * (s + s.T)
+    out = np.zeros((3, 3))
+    for op in ctx.symmetry.ops:
+        out += op.rot_cart @ s @ op.rot_cart.T
+    out /= ctx.symmetry.num_ops
+    return 0.5 * (out + out.T)

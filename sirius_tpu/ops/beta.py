@@ -30,6 +30,19 @@ from sirius_tpu.core.sht import lm_index, num_lm, ylm_real
 from sirius_tpu.crystal.unit_cell import UnitCell
 
 
+def beta_radial_table(t, qmax: float) -> RadialIntegralTable | None:
+    """RI_xi(q) = int j_l(q r) [r beta(r)] r dr table for one species
+    (single source for projector radial conventions)."""
+    if not t.num_beta:
+        return None
+    funcs = np.zeros((t.num_beta, len(t.r)))
+    for i, b in enumerate(t.beta):
+        funcs[i, : b.nr] = b.rbeta
+    return RadialIntegralTable.build(
+        t.r, funcs, np.array([b.l for b in t.beta]), qmax, m=1
+    )
+
+
 @dataclasses.dataclass
 class BetaProjectors:
     """Dense per-k beta-projector tables + packed D/Q matrices.
@@ -65,19 +78,7 @@ class BetaProjectors:
         nk, ngk = gkvec.num_kpoints, gkvec.ngk_max
         lmax = max((t.lmax_beta for t in uc.atom_types), default=-1)
         # per-type radial integral tables RI(idxrf, q)
-        tables = []
-        for t in uc.atom_types:
-            if t.num_beta:
-                funcs = np.zeros((t.num_beta, len(t.r)))
-                for i, b in enumerate(t.beta):
-                    funcs[i, : b.nr] = b.rbeta
-                tables.append(
-                    RadialIntegralTable.build(
-                        t.r, funcs, np.array([b.l for b in t.beta]), qmax, m=1
-                    )
-                )
-            else:
-                tables.append(None)
+        tables = [beta_radial_table(t, qmax) for t in uc.atom_types]
         # count total projectors (lm-expanded) over atoms
         counts = [uc.atom_types[it].num_beta_lm for it in uc.type_of_atom]
         nbeta_tot = int(np.sum(counts))
