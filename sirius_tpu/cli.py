@@ -18,6 +18,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("input", nargs="?", default="sirius.json", help="JSON input file")
     p.add_argument("--test_against", help="reference output JSON to compare against")
     p.add_argument(
+        "--task",
+        default="ground_state_new",
+        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "k_point_path"],
+        help="calculation task (reference sirius.scf task semantics)",
+    )
+    p.add_argument(
         "--platform",
         default=None,
         choices=["cpu", "tpu", "axon"],
@@ -55,7 +61,7 @@ def main(argv: list[str] | None = None) -> int:
             print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
             return 2
         raise
-    return run_scf_from_file(args.input, test_against=args.test_against)
+    return run_scf_from_file(args.input, test_against=args.test_against, task=args.task)
 
 
 if __name__ == "__main__":

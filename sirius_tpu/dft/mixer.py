@@ -13,7 +13,8 @@ import numpy as np
 
 
 class Mixer:
-    KNOWN = ("linear", "anderson", "anderson_stable", "broyden2")
+    # broyden1 appears in legacy reference decks (verification/test21)
+    KNOWN = ("linear", "anderson", "anderson_stable", "broyden1", "broyden2")
 
     def __init__(self, cfg, glen2: np.ndarray | None = None, num_components: int = 1):
         if cfg.type not in self.KNOWN:
@@ -45,7 +46,7 @@ class Mixer:
         f = x_out - x_in
         if self.kind == "linear" or not self._x:
             nxt = x_in + self.beta * f
-        elif self.kind in ("anderson", "anderson_stable", "broyden2"):
+        elif self.kind in ("anderson", "anderson_stable", "broyden1", "broyden2"):
             # Anderson acceleration (type-II): minimize ||f - sum g_j df_j||
             m = len(self._x)
             dfs = [f - self._f[j] for j in range(m)]

@@ -357,25 +357,95 @@ def _band_gap(evals: np.ndarray, occ: np.ndarray, ctx: SimulationContext) -> flo
     return max(gap, 0.0)
 
 
-def run_scf_from_file(path: str, test_against: str | None = None) -> int:
+def run_scf_from_file(
+    path: str, test_against: str | None = None, task: str = "ground_state_new"
+) -> int:
     import os
 
     cfg = load_config(path)
     base_dir = os.path.dirname(os.path.abspath(path))
-    result = run_scf(cfg, base_dir)
-    out = {"ground_state": result}
+    state_file = os.path.join(base_dir, "sirius.h5")
+    ref = None
+    if test_against:
+        with open(test_against) as f:
+            ref = json.load(f)["ground_state"]
+        # a reference quantity we would silently not compute is a failed
+        # comparison waiting to happen — switch the calculations on
+        if "forces" in ref:
+            cfg.control.print_forces = True
+        if "stress" in ref:
+            cfg.control.print_stress = True
+    if task == "ground_state_relax":
+        from sirius_tpu.dft.relax import relax_atoms
+
+        rr = relax_atoms(cfg, base_dir)
+        result = rr["ground_state"]
+        result["relaxation"] = {k: rr[k] for k in ("converged", "num_steps", "history", "final_positions")}
+    elif task == "ground_state_restart":
+        result = run_scf(cfg, base_dir, restart_from=state_file, save_to=state_file)
+    elif task == "k_point_path":
+        from sirius_tpu.context import SimulationContext
+        from sirius_tpu.dft.bands import band_path, sample_path
+        from sirius_tpu.dft.xc import XCFunctional
+
+        # vk defines the band path, NOT the SCF mesh (reference task
+        # semantics: SCF on ngridk, then bands along vk)
+        vk_path = list(cfg.parameters.vk)
+        cfg.parameters.vk = []
+        ctx = SimulationContext.create(cfg, base_dir)
+        result = run_scf(cfg, base_dir, save_to=state_file, ctx=ctx)
+        cfg.parameters.vk = vk_path  # restore: the echoed config must match
+        from sirius_tpu.dft.potential import generate_potential
+        from sirius_tpu.io.checkpoint import load_state
+        from sirius_tpu.ops.augmentation import d_operator
+
+        state = load_state(state_file, ctx)
+        xc = XCFunctional(cfg.parameters.xc_functionals)
+        pot = generate_potential(ctx, state["rho_g"], xc, state.get("mag_g"))
+        # screened per-spin D (ultrasoft) — same operator the SCF solved with
+        if ctx.aug is not None:
+            d_full = np.stack([
+                d_operator(
+                    ctx.unit_cell, ctx.gvec, ctx.aug,
+                    pot.veff_g + (0 if pot.bz_g is None else (pot.bz_g if ispn == 0 else -pot.bz_g)),
+                    ctx.beta,
+                )
+                for ispn in range(ctx.num_spins)
+            ])
+        else:
+            d_full = None
+        vk = vk_path if vk_path else [[0, 0, 0], [0.5, 0, 0]]
+        result["band_path"] = band_path(
+            ctx, pot, sample_path(np.asarray(vk)), d_full=d_full
+        )
+    else:  # ground_state_new
+        result = run_scf(cfg, base_dir, save_to=state_file)
+    out = {
+        "ground_state": result,
+        "task": task,
+        "config": cfg.to_dict(),
+        "git_hash": "",
+        "comm_world_size": 1,
+    }
     print(json.dumps({"energy": result["energy"], "efermi": result["efermi"],
                       "converged": result["converged"],
                       "num_scf_iterations": result["num_scf_iterations"]}, indent=2))
     with open("output.json", "w") as f:
         json.dump(out, f, indent=2)
-    if test_against:
-        with open(test_against) as f:
-            ref = json.load(f)["ground_state"]
+    if ref is not None:
+        ok = True
         de = abs(ref["energy"]["total"] - result["energy"]["total"])
         print(f"|dE_total| vs reference: {de:.3e}")
-        if de > 1e-5:
-            print("TEST FAILED")
-            return 1
-        print("TEST PASSED")
+        ok &= de < 1e-5
+        for key, label, tol in (("forces", "|dF|_max", 1e-5), ("stress", "|dsigma|_max", 1e-5)):
+            if key in ref:
+                if key not in result:
+                    print(f"{key}: present in reference but not computed -> FAIL")
+                    ok = False
+                    continue
+                d = float(np.abs(np.asarray(ref[key]) - np.asarray(result[key])).max())
+                print(f"{label} vs reference: {d:.3e}")
+                ok &= d < tol
+        print("TEST PASSED" if ok else "TEST FAILED")
+        return 0 if ok else 1
     return 0
