@@ -113,6 +113,12 @@ def run_scf(
     if any(t.pseudo_type == "PAW" for t in ctx.unit_cell.atom_types):
         raise NotImplementedError("PAW on-site terms are not implemented yet")
     polarized = ctx.num_mag_dims == 1
+    # wave-function precision: fp32 runs the band solve in complex64
+    # (reference precision_wf, dft_ground_state.cpp:216-304 fp32 SCF with
+    # fp64 polish via settings.fp32_to_fp64_rms)
+    if p.precision_wf not in ("fp32", "fp64"):
+        raise ValueError(f"precision_wf must be fp32 or fp64, got '{p.precision_wf}'")
+    wf_dtype = jnp.complex64 if p.precision_wf == "fp32" else jnp.complex128
 
     rho_g = initial_density_g(ctx)
     mag_g = initial_magnetization_g(ctx) if polarized else None
@@ -128,6 +134,22 @@ def run_scf(
     mixer = Mixer(cfg.mixer, ctx.gvec.glen2, num_components=2 if polarized else 1)
     # constant device tables, uploaded once (not per iteration)
     beta_dev = [jnp.asarray(ctx.beta.beta_gk[ik]) for ik in range(nk)]
+    # per-(k, dtype) Hamiltonian parameter cache: only veff_r/dion change
+    # between iterations, everything else is uploaded once via _replace
+    _params_cache: dict = {}
+
+    def hk_params(ik, veff_r, dmat, dtype):
+        from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+        key = (ik, dtype)
+        if key not in _params_cache:
+            _params_cache[key] = make_hk_params(ctx, ik, veff_r, dmat, dtype=dtype)
+            return _params_cache[key]
+        rdt = real_dtype_of(dtype)
+        return _params_cache[key]._replace(
+            veff_r=jnp.asarray(veff_r, dtype=rdt),
+            dion=jnp.asarray(dmat if dmat is not None else ctx.beta.dion, dtype=rdt),
+        )
     do_symmetrize = (
         p.use_symmetry and ctx.symmetry is not None and ctx.symmetry.num_ops > 1
     )
@@ -163,18 +185,21 @@ def run_scf(
             for ik in range(nk):
                 per_spin = []
                 for ispn in range(ns):
-                    params = make_hk_params(
-                        ctx, ik, pot.veff_r_coarse[ispn], d_by_spin[ispn]
+                    from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+                    params = hk_params(
+                        ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype
                     )
                     v0 = float(np.real(pot.veff_g[0]))
                     h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
+                    rdt = real_dtype_of(wf_dtype)
                     ev, x, rn = davidson(
                         apply_h_s,
                         params,
-                        psi[ik, ispn],
-                        jnp.asarray(h_diag),
-                        jnp.asarray(o_diag),
-                        jnp.asarray(ctx.gkvec.mask[ik]),
+                        psi[ik, ispn].astype(wf_dtype),
+                        jnp.asarray(h_diag, dtype=rdt),
+                        jnp.asarray(o_diag, dtype=rdt),
+                        params.mask,
                         num_steps=itsol.num_steps,
                         res_tol=itsol.residual_tolerance,
                     )
@@ -261,6 +286,16 @@ def run_scf(
 
         de = abs(e_total - e_prev) if e_prev is not None else np.inf
         e_prev = e_total
+        # fp32 -> fp64 polish switch (reference settings.fp32_to_fp64_rms);
+        # when it fires, force at least one fp64 iteration before declaring
+        # convergence so the final state is genuinely double precision
+        if (
+            wf_dtype == jnp.complex64
+            and cfg.settings.fp32_to_fp64_rms > 0
+            and rms < cfg.settings.fp32_to_fp64_rms
+        ):
+            wf_dtype = jnp.complex128
+            continue
         if de < p.energy_tol and rms < p.density_tol:
             converged = True
             break

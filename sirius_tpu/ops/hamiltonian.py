@@ -15,6 +15,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def real_dtype_of(dtype):
+    """The real dtype paired with a complex working dtype (single source for
+    the precision-tier mapping)."""
+    return jnp.float32 if dtype == jnp.complex64 else jnp.float64
+
+
 class HkParams(NamedTuple):
     """Everything needed to apply H and S at one k-point (pytree)."""
 
@@ -28,10 +34,16 @@ class HkParams(NamedTuple):
 
 
 def make_hk_params(
-    ctx, ik: int, veff_r_coarse: np.ndarray, dmat: np.ndarray | None = None
+    ctx,
+    ik: int,
+    veff_r_coarse: np.ndarray,
+    dmat: np.ndarray | None = None,
+    dtype=jnp.complex128,
 ) -> HkParams:
     """dmat: full D matrix (bare D_ion + ultrasoft V_eff augmentation term);
-    defaults to the bare D_ion for norm-conserving runs."""
+    defaults to the bare D_ion for norm-conserving runs. dtype selects the
+    wave-function precision (complex64 = reference precision_wf fp32; the
+    TPU hot path)."""
     nbeta = ctx.beta.num_beta_total
     beta = ctx.beta.beta_gk[ik] if nbeta else np.zeros((0, ctx.gkvec.ngk_max))
     qmat = (
@@ -39,14 +51,15 @@ def make_hk_params(
         if ctx.beta.qmat is not None
         else np.zeros((nbeta, nbeta))
     )
+    rdtype = real_dtype_of(dtype)
     return HkParams(
-        veff_r=jnp.asarray(veff_r_coarse),
-        ekin=jnp.asarray(ctx.gkvec.kinetic()[ik]),
-        mask=jnp.asarray(ctx.gkvec.mask[ik]),
+        veff_r=jnp.asarray(veff_r_coarse, dtype=rdtype),
+        ekin=jnp.asarray(ctx.gkvec.kinetic()[ik], dtype=rdtype),
+        mask=jnp.asarray(ctx.gkvec.mask[ik], dtype=rdtype),
         fft_index=jnp.asarray(ctx.gkvec.fft_index[ik]),
-        beta=jnp.asarray(beta, dtype=jnp.complex128),
-        dion=jnp.asarray(ctx.beta.dion if dmat is None else dmat),
-        qmat=jnp.asarray(qmat),
+        beta=jnp.asarray(beta, dtype=dtype),
+        dion=jnp.asarray(ctx.beta.dion if dmat is None else dmat, dtype=rdtype),
+        qmat=jnp.asarray(qmat, dtype=rdtype),
     )
 
 

@@ -44,7 +44,9 @@ def make_hkset_params(
     nk = ctx.gkvec.num_kpoints
     dion = ctx.beta.dion if d_full is None else d_full
     qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros((nbeta, nbeta))
-    rdtype = jnp.float32 if dtype == jnp.complex64 else jnp.float64
+    from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+    rdtype = real_dtype_of(dtype)
     ekin = ctx.gkvec.kinetic()
     h_diag = np.empty((nk, ctx.gkvec.ngk_max))
     o_diag = np.empty_like(h_diag)

@@ -29,7 +29,11 @@ def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6)
     """Lowest-nev gen-EVP of a possibly rank-deficient subspace pair."""
     s, u = jnp.linalg.eigh(ssub)
     smax = jnp.max(jnp.abs(s))
-    good = s > 1e-13 * smax
+    # rank cutoff must scale with the working precision: eigh noise sits at
+    # ~eps*smax (1e-7 for c64), so a fixed 1e-13 would rsqrt-amplify noise
+    # directions in single precision
+    eps = jnp.finfo(ssub.real.dtype).eps
+    good = s > 50.0 * eps * smax
     t = u * jnp.where(good, jax.lax.rsqrt(jnp.where(good, s, 1.0)), 0.0)[None, :]
     at = t.conj().T @ hsub @ t
     at = at + jnp.diag(jnp.where(good, 0.0, big).astype(at.dtype))
@@ -66,7 +70,7 @@ def davidson(
     def ortho(x):
         g = (x * mask) @ (x * mask).conj().T
         s, u = jnp.linalg.eigh(g)
-        good = s > 1e-12 * jnp.max(jnp.abs(s))
+        good = s > 50.0 * jnp.finfo(g.real.dtype).eps * jnp.max(jnp.abs(s))
         t = u * jnp.where(good, jax.lax.rsqrt(jnp.where(good, s, 1.0)), 0.0)[None, :]
         return t.conj().T @ x
 
