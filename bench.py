@@ -2,82 +2,153 @@
 
 Workload: BASELINE config 1 class — 2-atom silicon, ultrasoft-style
 projectors, gk_cutoff 6 / pw_cutoff 20, Gamma-only, 26 bands — one full SCF
-iteration's band solve (20-step blocked Davidson = 123 H*psi applications
-per band block) plus the density reduction, in complex64 on the local
-accelerator.
+iteration's band solve (20-step blocked Davidson) plus the density
+reduction, in complex64 on the local accelerator.
 
 Baseline anchor: the reference's own verification run of the same class
 (verification/test08 output_ref.json: scf_time 6.33 s / 30 iterations =
 0.211 s per SCF iteration on the reference's CPU node; no per-GPU numbers
 are published in-tree, BASELINE.json "published": {}). vs_baseline =
-baseline_iter_time / measured_iter_time (>1 means faster than the reference
-CPU anchor).
+baseline_iter_time / measured_iter_time (>1 = faster than that anchor).
 
-Prints exactly one JSON line.
+Robustness: the TPU remote-compile service in this environment can wedge
+indefinitely (see .claude memory); each workload tier runs in a subprocess
+with a hard timeout and the harness falls back to progressively smaller
+programs, then to CPU, rather than hanging the driver.
+
+Prints exactly one JSON line (the last line of stdout).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 REF_ITER_TIME_S = 6.325581577 / 30  # test08 scf_time / num_scf_iterations
 
 
-def main() -> None:
+def _workload(tier: str, platform: str) -> None:
+    """Run one tier and print its JSON result (subprocess entry)."""
     import jax
 
-    jax.config.update("jax_enable_x64", False)  # TPU path: f32/c64 only
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
     import jax.numpy as jnp
+    import numpy as np
 
-    from sirius_tpu.parallel.batched import davidson_kset, density_kset, make_hkset_params
+    from sirius_tpu.parallel.batched import (
+        davidson_kset,
+        density_kset,
+        make_hkset_params,
+    )
     from sirius_tpu.testing import synthetic_silicon_context
 
-    platform = jax.devices()[0].platform
+    plat = jax.devices()[0].platform
     ctx = synthetic_silicon_context(
         gk_cutoff=6.0, pw_cutoff=20.0, ngridk=(1, 1, 1), num_bands=26,
         use_symmetry=False,
     )
     nk, ns, nb, ngk = 1, 1, 26, ctx.gkvec.ngk_max
-    num_steps = 20
-
     params = make_hkset_params(
         ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64
     )
     rng = np.random.default_rng(0)
     psi = (
-        rng.standard_normal((nk, ns, nb, ngk)) + 1j * rng.standard_normal((nk, ns, nb, ngk))
+        rng.standard_normal((nk, ns, nb, ngk))
+        + 1j * rng.standard_normal((nk, ns, nb, ngk))
     ).astype(np.complex64) * ctx.gkvec.mask[:, None, None, :].astype(np.float32)
     psi = jnp.asarray(psi)
     occ_w = jnp.ones((nk, ns, nb), dtype=jnp.float32)
 
-    def one_iter(psi):
-        ev, psi2, rn = davidson_kset(params, psi, num_steps=num_steps)
-        rho = density_kset(params, psi2, occ_w)
-        return ev, psi2, rho
+    if tier == "full":
+        num_steps = 20
 
-    # warmup/compile
-    ev, psi2, rho = one_iter(psi)
-    jax.block_until_ready((ev, rho))
+        def one_iter(p):
+            ev, p2, rn = davidson_kset(params, p, num_steps=num_steps)
+            rho = density_kset(params, p2, occ_w)
+            return ev, p2, rho
 
+        label = "SCF-iteration wall time (20-step band solve + density)"
+    else:  # "hpsi": raw Hamiltonian application throughput
+        from sirius_tpu.ops.hamiltonian import HkParams, apply_h_s
+
+        pk = HkParams(
+            veff_r=params.veff_r, ekin=params.ekin[0], mask=params.mask[0],
+            fft_index=params.fft_index[0], beta=params.beta[0],
+            dion=params.dion, qmat=params.qmat,
+        )
+
+        @jax.jit
+        def hpsi_loop(p):
+            def body(c, _):
+                h, s = apply_h_s(pk, c)
+                return h / jnp.linalg.norm(h), None
+
+            out, _ = jax.lax.scan(body, p[0, 0], None, length=62)
+            return out
+
+        def one_iter(p):
+            return (hpsi_loop(p),)
+
+        label = "62x H*psi application wall time (local+nonlocal, 26 bands)"
+
+    out = one_iter(psi)
+    jax.block_until_ready(out)
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        ev, psi2, rho = one_iter(psi)
-        jax.block_until_ready((ev, rho))
+        out = one_iter(psi)
+        jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     iter_time = float(np.median(times))
-
+    # the hpsi micro-tier is NOT comparable to the whole-iteration anchor
+    vs = round(REF_ITER_TIME_S / iter_time, 3) if tier == "full" else 0.0
     print(
         json.dumps(
             {
-                "metric": f"SCF-iteration wall time (band solve + density), "
-                f"Si-2atom US gk=6/pw=20 nb=26 c64 on {platform}",
+                "metric": f"{label}, Si-2atom US gk=6/pw=20 nb=26 c64 on {plat}",
                 "value": round(iter_time, 6),
                 "unit": "s/iteration",
-                "vs_baseline": round(REF_ITER_TIME_S / iter_time, 3),
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+def main() -> None:
+    if len(sys.argv) == 3 and sys.argv[1] == "--tier":
+        tier, platform = sys.argv[2].split(":")
+        _workload(tier, platform)
+        return
+    # tiers: full program on default platform, then smaller, then CPU
+    tiers = ["full:default", "hpsi:default", "full:cpu"]
+    timeouts = [900, 600, 900]
+    for tier, tmo in zip(tiers, timeouts):
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--tier", tier],
+                capture_output=True, text=True, timeout=tmo,
+            )
+            lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+            if r.returncode == 0 and lines:
+                print(lines[-1])
+                return
+            sys.stderr.write(
+                f"bench tier {tier} failed (rc={r.returncode}):\n{r.stderr[-800:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench tier {tier} timed out after {tmo}s\n")
+    print(
+        json.dumps(
+            {
+                "metric": "benchmark could not run (accelerator compile service unavailable)",
+                "value": -1.0,
+                "unit": "s/iteration",
+                "vs_baseline": 0.0,
             }
         )
     )

@@ -144,6 +144,20 @@ class SettingsConfig:
 
 
 @dataclasses.dataclass
+class HubbardConfig:
+    # reference input_schema.json "hubbard" section (subset in use)
+    simplified: bool = False
+    orthogonalize: bool = False
+    normalize: bool = False
+    full_orthogonalization: bool = False
+    hubbard_subspace_method: str = "none"
+    local: list = dataclasses.field(default_factory=list)
+    nonlocal_: list = dataclasses.field(default_factory=list)
+    local_constraint: list = dataclasses.field(default_factory=list)
+    constraint_method: str = "energy"
+
+
+@dataclasses.dataclass
 class UnitCellConfig:
     lattice_vectors: list = dataclasses.field(default_factory=lambda: [[1, 0, 0], [0, 1, 0], [0, 0, 1]])
     lattice_vectors_scale: float = 1.0
@@ -160,6 +174,7 @@ _SECTION_TYPES = {
     "mixer": MixerConfig,
     "settings": SettingsConfig,
     "unit_cell": UnitCellConfig,
+    "hubbard": HubbardConfig,
 }
 
 
@@ -171,7 +186,8 @@ class Config:
     mixer: MixerConfig = dataclasses.field(default_factory=MixerConfig)
     settings: SettingsConfig = dataclasses.field(default_factory=SettingsConfig)
     unit_cell: UnitCellConfig = dataclasses.field(default_factory=UnitCellConfig)
-    # sections parsed but not yet consumed (hubbard, nlcg, vcsqnm)
+    hubbard: HubbardConfig = dataclasses.field(default_factory=HubbardConfig)
+    # sections parsed but not yet consumed (nlcg, vcsqnm)
     extra: dict = dataclasses.field(default_factory=dict)
 
     @staticmethod
@@ -185,8 +201,9 @@ class Config:
             section = getattr(cfg, sec)
             known = {f.name for f in dataclasses.fields(typ)}
             for k, v in val.items():
-                if k in known:
-                    setattr(section, k, v)
+                key = "nonlocal_" if (sec == "hubbard" and k == "nonlocal") else k
+                if key in known:
+                    setattr(section, key, v)
                 else:
                     cfg.extra.setdefault(sec, {})[k] = v
         return cfg

@@ -16,7 +16,10 @@ def band_path(
     kpoints: np.ndarray,  # (nk, 3) fractional path vertices (already sampled)
     num_bands: int | None = None,
     d_full=None,
+    vhub: np.ndarray | None = None,  # converged Hubbard potential [ns, ...]
 ) -> dict:
+    import dataclasses as _dc
+
     import jax.numpy as jnp
 
     from sirius_tpu.core.gvec import GkVec
@@ -28,6 +31,17 @@ def band_path(
     kpts = np.atleast_2d(np.asarray(kpoints, dtype=np.float64))
     gk = GkVec.build(ctx.gvec, kpts, ctx.cfg.parameters.gk_cutoff, ctx.fft_coarse)
     beta = BetaProjectors.build(ctx.unit_cell, gk, qmax=ctx.cfg.parameters.gk_cutoff + 1e-9)
+    hub_path = None
+    if vhub is not None and ctx.cfg.parameters.hubbard_correction:
+        # rebuild the Hubbard orbital tables on the path k-points so NSCF
+        # bands include the converged U potential
+        from sirius_tpu.ops.hubbard import HubbardData
+
+        # path projectors share the cell layout, so the SCF qmat applies
+        ctx_path = _dc.replace(
+            ctx, gkvec=gk, beta=_dc.replace(beta, qmat=ctx.beta.qmat)
+        )
+        hub_path = HubbardData.build(ctx_path)
     ns = ctx.num_spins
     dion = ctx.beta.dion if d_full is None else d_full
     qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros_like(dion)
@@ -45,6 +59,8 @@ def band_path(
                 beta=jnp.asarray(beta.beta_gk[ik], dtype=jnp.complex128),
                 dion=jnp.asarray(dion if np.ndim(dion) == 2 else dion[ispn]),
                 qmat=jnp.asarray(qmat),
+                hub=None if hub_path is None else jnp.asarray(hub_path.phi_s_gk[ik]),
+                vhub=None if hub_path is None else jnp.asarray(vhub[ispn]),
             )
             x0 = (
                 rng.standard_normal((nb, gk.ngk_max))

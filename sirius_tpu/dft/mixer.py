@@ -16,7 +16,17 @@ class Mixer:
     # broyden1 appears in legacy reference decks (verification/test21)
     KNOWN = ("linear", "anderson", "anderson_stable", "broyden1", "broyden2")
 
-    def __init__(self, cfg, glen2: np.ndarray | None = None, num_components: int = 1):
+    def __init__(
+        self,
+        cfg,
+        glen2: np.ndarray | None = None,
+        num_components: int = 1,
+        extra_len: int = 0,
+    ):
+        """num_components: G-sized components (charge first, then e.g.
+        magnetization); extra_len: trailing flat entries mixed with plain l2
+        (occupation matrices etc., reference mixer tuple of function spaces).
+        """
         if cfg.type not in self.KNOWN:
             raise ValueError(
                 f"unknown mixer type '{cfg.type}' (supported: {self.KNOWN})"
@@ -30,7 +40,11 @@ class Mixer:
             # (magnetization), matching the reference mixer_functions.cpp
             g2 = np.where(glen2 > 1e-12, glen2, np.inf)
             w = 4.0 * np.pi / g2
-            self.weight = np.concatenate([w] + [np.ones_like(w)] * (num_components - 1))
+            self.weight = np.concatenate(
+                [w]
+                + [np.ones_like(w)] * (num_components - 1)
+                + [np.ones(extra_len)]
+            )
         self._x: list[np.ndarray] = []  # input history
         self._f: list[np.ndarray] = []  # residual history f = x_out - x_in
 

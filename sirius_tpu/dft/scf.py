@@ -120,6 +120,35 @@ def run_scf(
         raise ValueError(f"precision_wf must be fp32 or fp64, got '{p.precision_wf}'")
     wf_dtype = jnp.complex64 if p.precision_wf == "fp32" else jnp.complex128
 
+    from sirius_tpu.ops.hubbard import (
+        HubbardData,
+        hubbard_potential_and_energy,
+        occupation_matrix,
+        symmetrize_occupation,
+    )
+
+    hub = HubbardData.build(ctx)
+    vhub = None
+    e_hub = e_hub_one_el = 0.0
+    if hub is not None:
+        # initial occupation guess: even diagonal filling of the shell
+        by_label = {e["atom_type"]: e for e in cfg.hubbard.local}
+        n0 = np.zeros((ns, hub.num_hub_total, hub.num_hub_total), dtype=np.complex128)
+        for ia, off, nm, u_eff, alpha, l in hub.blocks:
+            occ0 = float(
+                by_label[ctx.unit_cell.atom_types[ctx.unit_cell.type_of_atom[ia]].label]
+                .get("total_initial_occupancy", nm)
+            )
+            for ispn in range(ns):
+                # scaled convention: <= 1 per (m, spin channel)
+                np.fill_diagonal(
+                    n0[ispn, off : off + nm, off : off + nm],
+                    min(1.0, occ0 / 2.0 / nm),
+                )
+        vhub, e_hub, e_hub_one_el = hubbard_potential_and_energy(
+            hub, n0, ctx.max_occupancy
+        )
+
     rho_g = initial_density_g(ctx)
     mag_g = initial_magnetization_g(ctx) if polarized else None
     if restart_from:
@@ -131,36 +160,59 @@ def run_scf(
             mag_g = state.get("mag_g", mag_g)
     pot = generate_potential(ctx, rho_g, xc, mag_g)
     psi = _initial_subspace(ctx)
-    mixer = Mixer(cfg.mixer, ctx.gvec.glen2, num_components=2 if polarized else 1)
+    om_size = 0 if hub is None else ns * hub.num_hub_total * hub.num_hub_total
+    mixer = Mixer(
+        cfg.mixer, ctx.gvec.glen2,
+        num_components=2 if polarized else 1,
+        extra_len=om_size,
+    )
     # constant device tables, uploaded once (not per iteration)
     beta_dev = [jnp.asarray(ctx.beta.beta_gk[ik]) for ik in range(nk)]
     # per-(k, dtype) Hamiltonian parameter cache: only veff_r/dion change
     # between iterations, everything else is uploaded once via _replace
     _params_cache: dict = {}
 
-    def hk_params(ik, veff_r, dmat, dtype):
+    def hk_params(ik, veff_r, dmat, dtype, vhub_s=None):
         from sirius_tpu.ops.hamiltonian import real_dtype_of
 
         key = (ik, dtype)
         if key not in _params_cache:
-            _params_cache[key] = make_hk_params(ctx, ik, veff_r, dmat, dtype=dtype)
+            _params_cache[key] = make_hk_params(
+                ctx, ik, veff_r, dmat, dtype=dtype,
+                hub_phi=None if hub is None else hub.phi_s_gk[ik],
+                vhub=vhub_s,
+            )
             return _params_cache[key]
         rdt = real_dtype_of(dtype)
         return _params_cache[key]._replace(
             veff_r=jnp.asarray(veff_r, dtype=rdt),
             dion=jnp.asarray(dmat if dmat is not None else ctx.beta.dion, dtype=rdt),
+            vhub=None if vhub_s is None else jnp.asarray(vhub_s, dtype=dtype),
         )
     do_symmetrize = (
         p.use_symmetry and ctx.symmetry is not None and ctx.symmetry.num_ops > 1
     )
 
-    def pack(r, m):
-        return np.concatenate([r, m]) if polarized else r
+    ng = ctx.gvec.num_gvec
+
+    def pack(r, m, o):
+        parts = [r]
+        if polarized:
+            parts.append(m)
+        if hub is not None:
+            parts.append(o.ravel())
+        return np.concatenate(parts) if len(parts) > 1 else r
 
     def unpack(x):
-        return (x[: ctx.gvec.num_gvec], x[ctx.gvec.num_gvec :]) if polarized else (x, None)
+        r = x[:ng]
+        m = x[ng : 2 * ng] if polarized else None
+        o = None
+        if hub is not None:
+            o = x[-om_size:].reshape(ns, hub.num_hub_total, hub.num_hub_total)
+        return r, m, o
 
-    x_mix = pack(rho_g, mag_g)
+    om_mixed = n0 if hub is not None else None
+    x_mix = pack(rho_g, mag_g, om_mixed)
 
     evals = np.zeros((nk, ns, nb))
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
@@ -188,7 +240,8 @@ def run_scf(
                     from sirius_tpu.ops.hamiltonian import real_dtype_of
 
                     params = hk_params(
-                        ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype
+                        ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype,
+                        vhub_s=None if vhub is None else vhub[ispn],
                     )
                     v0 = float(np.real(pot.veff_g[0]))
                     h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
@@ -223,6 +276,18 @@ def run_scf(
         )
         occ_np = np.asarray(occ)
 
+        # --- Hubbard occupation matrix (mixed jointly with the density) ---
+        om_new = None
+        if hub is not None:
+            om_new = occupation_matrix(ctx, hub, psi, occ_np, ctx.max_occupancy)
+            if do_symmetrize:
+                om_new = symmetrize_occupation(ctx, hub, om_new)
+            # the one-electron term inside eval_sum used the PREVIOUS V
+            e_hub_one_el = ctx.max_occupancy * sum(
+                float(np.real(np.trace(vhub[ispn] @ om_new[ispn])))
+                for ispn in range(ns)
+            )
+
         # --- density (per spin, then charge/magnetization assembly) ---
         with profile("scf::density"):
             rho_spin = generate_density_g(ctx, psi, occ_np)
@@ -250,11 +315,15 @@ def run_scf(
             rho_new = symmetrize_pw(ctx, rho_new)
             if polarized:
                 mag_new = symmetrize_pw(ctx, mag_new)
-        x_new = pack(rho_new, mag_new)
+        x_new = pack(rho_new, mag_new, om_new)
         rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
-        rho_g, mag_g = unpack(x_mix)
+        rho_g, mag_g, om_mixed = unpack(x_mix)
+        if hub is not None:
+            vhub, e_hub, _ = hubbard_potential_and_energy(
+                hub, om_mixed, ctx.max_occupancy
+            )
 
         # first-order (Harris-like) correction: E_pot[rho_out] under the new
         # vs old potential (reference dft_ground_state.cpp:245,320-322)
@@ -276,7 +345,7 @@ def run_scf(
         e = pot.energies
         e_total = (
             eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
-            + scf_correction
+            + scf_correction + (e_hub - e_hub_one_el if hub is not None else 0.0)
         )
         # reference etot_history records the free energy (dft_ground_state
         # etot_hist; verified against verification/test23 and test01 outputs)
@@ -308,7 +377,7 @@ def run_scf(
     eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
     e_total = (
         eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
-        + scf_correction
+        + scf_correction + (e_hub - e_hub_one_el if hub is not None else 0.0)
     )
     result = {
         "converged": converged,
@@ -333,18 +402,30 @@ def run_scf(
             "ewald": ctx.e_ewald,
             "entropy_sum": float(entropy_sum),
             "scf_correction": scf_correction,
+            "hubbard": e_hub if hub is not None else 0.0,
+            "hubbard_one_el": e_hub_one_el if hub is not None else 0.0,
         },
         "band_energies": evals.tolist(),
         "band_occupancies": occ_np.tolist(),
         "counters": dict(counters),
         "timers": timer_report(),
     }
+    if hub is not None:
+        result["_hubbard_v"] = vhub  # ndarray, consumed by the band-path task
     if polarized:
         result["magnetisation"] = {
             "total": [0.0, 0.0, float(np.real(mag_g[0]) * ctx.unit_cell.omega)]
         }
     if cfg.control.print_forces and num_iter_done > 0:
         from sirius_tpu.dft.forces import total_forces
+
+        if hub is not None:
+            import warnings
+
+            warnings.warn(
+                "Hubbard force contribution is not yet implemented; forces "
+                "are inconsistent with the DFT+U total energy"
+            )
 
         fterms = total_forces(
             ctx, rho_g, pot.vxc_g, pot.veff_g, pot.bz_g, psi, occ_np, evals,
@@ -451,10 +532,12 @@ def run_scf_from_file(
             d_full = None
         vk = vk_path if vk_path else [[0, 0, 0], [0.5, 0, 0]]
         result["band_path"] = band_path(
-            ctx, pot, sample_path(np.asarray(vk)), d_full=d_full
+            ctx, pot, sample_path(np.asarray(vk)), d_full=d_full,
+            vhub=result.get("_hubbard_v"),
         )
     else:  # ground_state_new
         result = run_scf(cfg, base_dir, save_to=state_file)
+    result.pop("_hubbard_v", None)  # ndarray, not JSON-serializable
     out = {
         "ground_state": result,
         "task": task,

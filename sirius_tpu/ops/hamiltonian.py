@@ -31,6 +31,8 @@ class HkParams(NamedTuple):
     beta: jax.Array  # [nbeta, ngk] (nbeta may be 0)
     dion: jax.Array  # [nbeta, nbeta]
     qmat: jax.Array  # [nbeta, nbeta]; all-zero if norm-conserving
+    hub: jax.Array = None  # [nhub, ngk] S-weighted Hubbard orbitals (or None)
+    vhub: jax.Array = None  # [nhub, nhub] Hubbard potential matrix (or None)
 
 
 def make_hk_params(
@@ -39,6 +41,8 @@ def make_hk_params(
     veff_r_coarse: np.ndarray,
     dmat: np.ndarray | None = None,
     dtype=jnp.complex128,
+    hub_phi: np.ndarray | None = None,  # (nhub, ngk) for this k
+    vhub: np.ndarray | None = None,  # (nhub, nhub), one spin channel
 ) -> HkParams:
     """dmat: full D matrix (bare D_ion + ultrasoft V_eff augmentation term);
     defaults to the bare D_ion for norm-conserving runs. dtype selects the
@@ -60,6 +64,8 @@ def make_hk_params(
         beta=jnp.asarray(beta, dtype=dtype),
         dion=jnp.asarray(ctx.beta.dion if dmat is None else dmat, dtype=rdtype),
         qmat=jnp.asarray(qmat, dtype=rdtype),
+        hub=None if hub_phi is None else jnp.asarray(hub_phi, dtype=dtype),
+        vhub=None if vhub is None else jnp.asarray(vhub, dtype=dtype),
     )
 
 
@@ -84,4 +90,8 @@ def apply_h_s(params: HkParams, psi: jax.Array) -> tuple[jax.Array, jax.Array]:
         # qmat is all-zero for norm-conserving species; the extra einsum is
         # negligible next to the FFTs and keeps the pytree static
         spsi = spsi + jnp.einsum("bx,xy,yg->bg", bp, params.qmat, params.beta)
+    if params.hub is not None and params.hub.shape[0]:
+        # Hubbard U: H psi += sum_{mn} phi_n V_{mn} <phi_m|psi>
+        hp = jnp.einsum("mg,bg->bm", jnp.conj(params.hub), psi)
+        hpsi = hpsi + jnp.einsum("bm,mn,ng->bg", hp, params.vhub, params.hub)
     return hpsi * params.mask, spsi * params.mask
