@@ -78,6 +78,25 @@ def generate_density_g(
     return out
 
 
+def atomic_sphere_radii(uc) -> np.ndarray:
+    """Per-atom non-overlapping sphere radii: half the nearest-neighbor
+    distance over periodic images, capped at 2 bohr (reference find_mt_radii
+    flavor)."""
+    rad = np.full(uc.num_atoms, 2.0)
+    if uc.num_atoms > 1:
+        pos = uc.positions_cart()
+        ts = np.array(
+            np.meshgrid(*[[-1, 0, 1]] * 3, indexing="ij")
+        ).reshape(3, -1).T @ uc.lattice
+        d = np.linalg.norm(
+            pos[:, None, None, :] - pos[None, :, None, :] - ts[None, None, :, :],
+            axis=-1,
+        )
+        d[d < 1e-8] = np.inf
+        rad = np.minimum(0.5 * d.min(axis=(1, 2)), 2.0)
+    return rad
+
+
 def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
     """Initial z-magnetization from per-atom starting moments.
 
@@ -93,20 +112,7 @@ def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
     out = np.zeros(gv.num_gvec, dtype=np.complex128)
     if not np.any(np.abs(uc.moments[:, 2]) > 1e-12):
         return out
-    # atomic sphere radius: half the nearest-neighbor distance, capped
-    pos = uc.positions_cart()
-    rad = np.full(uc.num_atoms, 2.0)
-    if uc.num_atoms > 1:
-        # nearest neighbor over periodic images (one shell is enough)
-        ts = np.array(
-            np.meshgrid(*[[-1, 0, 1]] * 3, indexing="ij")
-        ).reshape(3, -1).T @ uc.lattice
-        d = np.linalg.norm(
-            pos[:, None, None, :] - pos[None, :, None, :] - ts[None, None, :, :],
-            axis=-1,
-        )
-        d[d < 1e-8] = np.inf
-        rad = np.minimum(0.5 * d.min(axis=(1, 2)), 2.0)
+    rad = atomic_sphere_radii(uc)
     qshell = np.sqrt(gv.shell_g2)
     for ia in range(uc.num_atoms):
         mz = uc.moments[ia, 2]
@@ -154,3 +160,27 @@ def rho_real_space(ctx: SimulationContext, rho_g: np.ndarray) -> np.ndarray:
     return np.asarray(
         g_to_r(jnp.asarray(rho_g), jnp.asarray(ctx.gvec.fft_index), ctx.gvec.fft.dims)
     ).real
+
+
+def atomic_moments(ctx: SimulationContext, mag_g: np.ndarray) -> np.ndarray:
+    """Integral of m_z inside each atom's non-overlapping sphere (reference
+    Density::get_magnetisation MT moments):
+    int_{|r-ra|<R} e^{iG.r} dr = e^{iG.ra} (4 pi / G^3)(sin GR - GR cos GR).
+    """
+    gv = ctx.gvec
+    uc = ctx.unit_cell
+    glen = np.sqrt(gv.glen2)
+    radii = atomic_sphere_radii(uc)
+    out = np.empty(uc.num_atoms)
+    for ia in range(uc.num_atoms):
+        radius = float(radii[ia])
+        gr = glen * radius
+        w = np.empty_like(gr)
+        small = gr < 1e-8
+        w[~small] = 4.0 * np.pi / np.maximum(glen[~small], 1e-30) ** 3 * (
+            np.sin(gr[~small]) - gr[~small] * np.cos(gr[~small])
+        )
+        w[small] = 4.0 * np.pi * radius**3 / 3.0
+        phase = np.exp(2j * np.pi * (gv.millers @ uc.positions[ia]))
+        out[ia] = float(np.real(mag_g @ (w * phase)))
+    return out

@@ -18,6 +18,7 @@ import numpy as np
 from sirius_tpu.config.schema import Config, load_config
 from sirius_tpu.context import SimulationContext
 from sirius_tpu.dft.density import (
+    atomic_moments,
     generate_density_g,
     initial_density_g,
     initial_magnetization_g,
@@ -414,7 +415,10 @@ def run_scf(
         result["_hubbard_v"] = vhub  # ndarray, consumed by the band-path task
     if polarized:
         result["magnetisation"] = {
-            "total": [0.0, 0.0, float(np.real(mag_g[0]) * ctx.unit_cell.omega)]
+            "total": [0.0, 0.0, float(np.real(mag_g[0]) * ctx.unit_cell.omega)],
+            "atoms": [
+                [0.0, 0.0, float(mz)] for mz in atomic_moments(ctx, mag_g)
+            ],
         }
     if cfg.control.print_forces and num_iter_done > 0:
         from sirius_tpu.dft.forces import total_forces
