@@ -78,23 +78,21 @@ def generate_density_g(
     return out
 
 
-def atomic_sphere_radii(uc) -> np.ndarray:
+def atomic_sphere_radii(uc, rmax: float = 2.0) -> np.ndarray:
     """Per-atom non-overlapping sphere radii: half the nearest-neighbor
-    distance over periodic images, capped at 2 bohr (reference find_mt_radii
-    flavor)."""
-    rad = np.full(uc.num_atoms, 2.0)
-    if uc.num_atoms > 1:
-        pos = uc.positions_cart()
-        ts = np.array(
-            np.meshgrid(*[[-1, 0, 1]] * 3, indexing="ij")
-        ).reshape(3, -1).T @ uc.lattice
-        d = np.linalg.norm(
-            pos[:, None, None, :] - pos[None, :, None, :] - ts[None, None, :, :],
-            axis=-1,
-        )
-        d[d < 1e-8] = np.inf
-        rad = np.minimum(0.5 * d.min(axis=(1, 2)), 2.0)
-    return rad
+    distance over periodic images (including an atom's own images, so
+    single-atom cells are covered), capped at rmax (reference
+    control.rmt_max flavor)."""
+    pos = uc.positions_cart()
+    ts = np.array(
+        np.meshgrid(*[[-1, 0, 1]] * 3, indexing="ij")
+    ).reshape(3, -1).T @ uc.lattice
+    d = np.linalg.norm(
+        pos[:, None, None, :] - pos[None, :, None, :] - ts[None, None, :, :],
+        axis=-1,
+    )
+    d[d < 1e-8] = np.inf
+    return np.minimum(0.5 * d.min(axis=(1, 2)), rmax)
 
 
 def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
@@ -170,7 +168,9 @@ def atomic_moments(ctx: SimulationContext, mag_g: np.ndarray) -> np.ndarray:
     gv = ctx.gvec
     uc = ctx.unit_cell
     glen = np.sqrt(gv.glen2)
-    radii = atomic_sphere_radii(uc)
+    # reference per-atom moments use uniform control.rmt_max spheres
+    # (simulation_context.cpp:977); stay non-overlapping within that cap
+    radii = atomic_sphere_radii(uc, rmax=ctx.cfg.control.rmt_max)
     out = np.empty(uc.num_atoms)
     for ia in range(uc.num_atoms):
         radius = float(radii[ia])

@@ -312,6 +312,16 @@ def run_scf(
                 rho_spin[ispn] += rho_aug_g(ctx.unit_cell, ctx.gvec, ctx.aug, dm_blocks)
         rho_new = rho_spin.sum(axis=0)
         mag_new = rho_spin[0] - rho_spin[1] if polarized else None
+        if cfg.control.verification >= 1:
+            # electron-count audit (reference Density::check_num_electrons,
+            # dft_ground_state.cpp:305-308)
+            nel_got = float(np.real(rho_new[0]) * ctx.unit_cell.omega)
+            if abs(nel_got - nel) > 1e-6 * max(1.0, nel):
+                import warnings
+
+                warnings.warn(
+                    f"electron count from density {nel_got:.8f} != {nel:.8f}"
+                )
         if do_symmetrize:
             rho_new = symmetrize_pw(ctx, rho_new)
             if polarized:

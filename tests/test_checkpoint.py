@@ -1,0 +1,36 @@
+"""Checkpoint save/load round trip and mismatch detection (reference:
+sirius.h5 state file, Density/Potential save/load)."""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.io.checkpoint import load_state, save_state
+from sirius_tpu.testing import synthetic_silicon_context
+
+
+def test_roundtrip_and_mismatch(tmp_path):
+    ctx = synthetic_silicon_context(
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=4,
+        ultrasoft=False, use_symmetry=False,
+    )
+    rng = np.random.default_rng(0)
+    ng = ctx.gvec.num_gvec
+    rho = rng.standard_normal(ng) + 1j * rng.standard_normal(ng)
+    mag = rng.standard_normal(ng) + 1j * rng.standard_normal(ng)
+    psi = rng.standard_normal((1, 1, 4, ctx.gkvec.ngk_max)).astype(complex)
+    path = str(tmp_path / "state.h5")
+    save_state(path, ctx, rho, mag_g=mag, veff_g=rho * 2, psi=psi,
+               band_energies=np.zeros((1, 1, 4)), band_occupancies=np.ones((1, 1, 4)))
+    out = load_state(path, ctx)
+    np.testing.assert_allclose(out["rho_g"], rho)
+    np.testing.assert_allclose(out["mag_g"], mag)
+    np.testing.assert_allclose(out["veff_g"], rho * 2)
+    np.testing.assert_allclose(out["psi"], psi)
+    assert out["band_occupancies"].shape == (1, 1, 4)
+    # mismatched context (different cutoff -> different G set) must refuse
+    ctx2 = synthetic_silicon_context(
+        gk_cutoff=3.0, pw_cutoff=8.0, ngridk=(1, 1, 1), num_bands=4,
+        ultrasoft=False, use_symmetry=False,
+    )
+    with pytest.raises(ValueError):
+        load_state(path, ctx2)
