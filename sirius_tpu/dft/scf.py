@@ -328,6 +328,14 @@ def run_scf(
                 mag_new = symmetrize_pw(ctx, mag_new)
         x_new = pack(rho_new, mag_new, om_new)
         rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
+        if not np.all(np.isfinite(evals)) or not np.isfinite(
+            np.sum(np.abs(x_new))
+        ):
+            raise FloatingPointError(
+                f"SCF diverged at iteration {it + 1}: non-finite band "
+                "energies or density (try smaller mixer.beta or a better "
+                "initial guess)"
+            )
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
         rho_g, mag_g, om_mixed = unpack(x_mix)
