@@ -1,10 +1,22 @@
-"""Density mixers (reference: src/mixer/ — Linear, Anderson, Broyden2 over a
-tuple of function spaces with configurable inner products, mixer.hpp:37-63).
+"""Density mixers (reference: src/mixer/ — Linear, Anderson, Anderson_stable,
+Broyden2 over a tuple of function spaces with configurable inner products,
+mixer.hpp:37-63, mixer_factory.hpp:40-47 where "broyden1" is a
+backward-compatibility alias of Anderson).
 
-Round-1 scope: the mixed vector is rho(G) on the fine set (complex), with
-either the plain l2 inner product or the Hartree-weighted G-space metric
-(4 pi / G^2, reference mixer_functions.cpp use_hartree) which preconditions
-long-wavelength charge sloshing.
+The mixed vector is rho(G) on the fine set (complex) plus optional trailing
+components, with either the plain l2 inner product or the Hartree-weighted
+G-space metric (4 pi / G^2, reference mixer_functions.cpp use_hartree) which
+preconditions long-wavelength charge sloshing.
+
+Algorithms (all limited-memory quasi-Newton on x_{n+1} = x_n - G_n f_n):
+  linear           G_n = -beta I
+  anderson         type-II multisecant, normal-equations least squares
+                   (reference anderson_mixer.hpp; "broyden1" aliases here)
+  anderson_stable  same least-squares problem solved through a
+                   metric-weighted QR of the residual-difference block
+                   (reference anderson_stable_mixer.hpp, Fang & Saad 2009)
+  broyden2         recursive rank-1 inverse-Jacobian updates; the alpha_i
+                   recursion of broyden2_mixer.hpp:63-80
 """
 
 from __future__ import annotations
@@ -13,7 +25,6 @@ import numpy as np
 
 
 class Mixer:
-    # broyden1 appears in legacy reference decks (verification/test21)
     KNOWN = ("linear", "anderson", "anderson_stable", "broyden1", "broyden2")
 
     def __init__(
@@ -33,7 +44,7 @@ class Mixer:
             )
         self.beta = cfg.beta
         self.max_history = cfg.max_history
-        self.kind = cfg.type
+        self.kind = "anderson" if cfg.type == "broyden1" else cfg.type
         self.weight = None
         if cfg.use_hartree and glen2 is not None:
             # Hartree metric on the charge component; plain l2 on the others
@@ -56,24 +67,87 @@ class Mixer:
         d = x_out - x_in
         return float(np.sqrt(max(self._inner(d, d), 0.0) / d.size))
 
+    def _mix_anderson(self, x_in, f):
+        # type-II Anderson: minimize ||f - sum g_j df_j|| in the metric,
+        # df_j/dx_j spanned against the current point (normal equations)
+        m = len(self._x)
+        dfs = [f - self._f[j] for j in range(m)]
+        dxs = [x_in - self._x[j] for j in range(m)]
+        a = np.array([[self._inner(dfs[i], dfs[j]) for j in range(m)] for i in range(m)])
+        b = np.array([self._inner(dfs[i], f) for i in range(m)])
+        try:
+            g = np.linalg.lstsq(a + 1e-12 * np.trace(a) / max(m, 1) * np.eye(m), b, rcond=None)[0]
+        except np.linalg.LinAlgError:
+            g = np.zeros(m)
+        x_opt = x_in - sum(gi * dxi for gi, dxi in zip(g, dxs))
+        f_opt = f - sum(gi * dfi for gi, dfi in zip(g, dfs))
+        return x_opt + self.beta * f_opt
+
+    def _diff_blocks(self, x_in, f):
+        """Successive-difference blocks DF[:,i] = f_{i+1}-f_i etc. including
+        the current point as the newest history entry."""
+        xs = self._x + [x_in]
+        fs = self._f + [f]
+        n = len(xs)
+        dfs = np.stack([fs[i + 1] - fs[i] for i in range(n - 1)], axis=1)
+        dxs = np.stack([xs[i + 1] - xs[i] for i in range(n - 1)], axis=1)
+        return dfs, dxs
+
+    def _mix_anderson_stable(self, x_in, f):
+        # Solve the same least-squares problem through a metric-weighted QR
+        # of DF (reference anderson_stable_mixer.hpp):
+        #   x+ = x + beta (f - DF k) - DX k,   k = R^{-1} Q^H W^{1/2} f
+        # The projection DF k equals the weighted-space Q Q^H f backmapped,
+        # but is formed in UNWEIGHTED space: components with zero metric
+        # weight (the G=0 charge row under the Hartree metric) must not be
+        # divided back by W^{-1/2}.
+        dfs, dxs = self._diff_blocks(x_in, f)
+        sw = np.sqrt(self.weight)[:, None] if self.weight is not None else 1.0
+        q, r = np.linalg.qr(sw * dfs, mode="reduced")
+        # guard rank deficiency: drop near-dependent directions, then
+        # re-factorize the kept columns (subsetting Q/R of the original QR
+        # would not factor the kept block unless only trailing columns drop)
+        diag = np.abs(np.diag(r))
+        keep = diag > 1e-12 * max(diag.max(), 1e-300)
+        if not np.all(keep):
+            dfs, dxs = dfs[:, keep], dxs[:, keep]
+            if dfs.shape[1] == 0:
+                return x_in + self.beta * f
+            q, r = np.linalg.qr(sw * dfs, mode="reduced")
+        h = q.conj().T @ (np.ravel(sw) * f if self.weight is not None else f)
+        try:
+            k = np.linalg.solve(r, h)
+        except np.linalg.LinAlgError:
+            return x_in + self.beta * f
+        return x_in + self.beta * (f - dfs @ k) - dxs @ k
+
+    def _mix_broyden2(self, x_in, f):
+        # Recursive rank-1 inverse-Jacobian update, G_1 = -beta I
+        # (reference broyden2_mixer.hpp:63-80):
+        #   alpha_i = [<df_i, f_n> - sum_{j>i} alpha_j <df_i, df_j>] / <df_i, df_i>
+        #   x+ = x + beta f - sum_i alpha_i (beta df_i + dx_i)
+        dfs, dxs = self._diff_blocks(x_in, f)
+        m = dfs.shape[1]
+        gram = np.array(
+            [[self._inner(dfs[:, i], dfs[:, j]) for j in range(m)] for i in range(m)]
+        )
+        rhs = np.array([self._inner(dfs[:, i], f) for i in range(m)])
+        alpha = np.zeros(m)
+        for i in range(m - 1, -1, -1):
+            num = rhs[i] - sum(alpha[j] * gram[i, j] for j in range(i + 1, m))
+            alpha[i] = num / gram[i, i] if gram[i, i] > 1e-300 else 0.0
+        return x_in + self.beta * f - dfs @ (self.beta * alpha) - dxs @ alpha
+
     def mix(self, x_in: np.ndarray, x_out: np.ndarray) -> np.ndarray:
         f = x_out - x_in
         if self.kind == "linear" or not self._x:
             nxt = x_in + self.beta * f
-        elif self.kind in ("anderson", "anderson_stable", "broyden1", "broyden2"):
-            # Anderson acceleration (type-II): minimize ||f - sum g_j df_j||
-            m = len(self._x)
-            dfs = [f - self._f[j] for j in range(m)]
-            dxs = [x_in - self._x[j] for j in range(m)]
-            a = np.array([[self._inner(dfs[i], dfs[j]) for j in range(m)] for i in range(m)])
-            b = np.array([self._inner(dfs[i], f) for i in range(m)])
-            try:
-                g = np.linalg.lstsq(a + 1e-12 * np.trace(a) / max(m, 1) * np.eye(m), b, rcond=None)[0]
-            except np.linalg.LinAlgError:
-                g = np.zeros(m)
-            x_opt = x_in - sum(gi * dxi for gi, dxi in zip(g, dxs))
-            f_opt = f - sum(gi * dfi for gi, dfi in zip(g, dfs))
-            nxt = x_opt + self.beta * f_opt
+        elif self.kind == "anderson":
+            nxt = self._mix_anderson(x_in, f)
+        elif self.kind == "anderson_stable":
+            nxt = self._mix_anderson_stable(x_in, f)
+        elif self.kind == "broyden2":
+            nxt = self._mix_broyden2(x_in, f)
         else:
             raise ValueError(f"unknown mixer type '{self.kind}'")
         self._x.append(x_in.copy())

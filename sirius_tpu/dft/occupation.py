@@ -31,9 +31,11 @@ def occupancy(kind: str, x: jnp.ndarray, w: float) -> jnp.ndarray:
             -jnp.minimum(y * y, 200.0)
         ) / jnp.sqrt(2.0 * jnp.pi)
     if kind == "methfessel_paxton":
-        # order-1 MP: f_gauss + A1 H1(t) e^{-t^2}, A1 = -1/(4 sqrt(pi))
+        # order-1 MP: reference smearing.cpp evaluates A1*H1(z)*e^{-z^2} at
+        # z = -t with A1 = -1/(4 sqrt(pi)), H1(z) = 2z, so the term is
+        # +2t e^{-t^2}/(4 sqrt(pi)) in terms of t = (mu - eps)/w.
         e = jnp.exp(-jnp.minimum(t * t, 200.0))
-        return 0.5 * (1.0 + jax.scipy.special.erf(t)) - (2.0 * t) * e / (4.0 * SQRT_PI)
+        return 0.5 * (1.0 + jax.scipy.special.erf(t)) + (2.0 * t) * e / (4.0 * SQRT_PI)
     raise ValueError(f"unknown smearing '{kind}'")
 
 
@@ -52,9 +54,14 @@ def entropy_term(kind: str, x: jnp.ndarray, w: float) -> jnp.ndarray:
         y = t - 1.0 / SQRT2
         return -jnp.exp(-jnp.minimum(y * y, 200.0)) * (w - SQRT2 * x) / (2.0 * SQRT_PI)
     if kind == "methfessel_paxton":
-        # order-1 MP entropy: 0.5 A1 H2(t) e^{-t^2} with H2 = 4t^2-2
+        # order-1 MP entropy: w (2t^2-1) e^{-t^2} / (4 sqrt(pi)), the QE
+        # w1gauss(n=1) form; satisfies s'(x) = x f'(x) against the MP1
+        # occupancy above. (reference smearing.cpp:200 has a typo in the
+        # recursion coefficient, `i+4` for QE's `i*4`; we follow the
+        # thermodynamically consistent QE form.) Unlike the other kinds this
+        # term is not negative-definite (positive for |t| > 1/sqrt(2)).
         e = jnp.exp(-jnp.minimum(t * t, 200.0))
-        return w * 0.5 * (-1.0 / (4.0 * SQRT_PI)) * (4.0 * t * t - 2.0) * e
+        return w * (2.0 * t * t - 1.0) * e / (4.0 * SQRT_PI)
     raise ValueError(f"unknown smearing '{kind}'")
 
 

@@ -3,7 +3,8 @@ ground_state_relax driven by Force + the vcsqnm optimizer for variable-cell;
 here fixed-cell BFGS over Cartesian positions using the analytic forces).
 
 Each objective evaluation is a converged SCF; successive steps warm-start
-from the previous density via an in-memory checkpoint of rho(G)/mag(G)."""
+from the previous step's wave functions and a delta-extrapolated density
+(rho_prev - rho_atomic(old positions) + rho_atomic(new positions))."""
 
 from __future__ import annotations
 
@@ -30,7 +31,11 @@ def relax_atoms(
     history = []
     res = None
 
+    warm = {"state": None, "rho_at": None}
+
     def scf_at(positions):
+        from sirius_tpu.dft.density import initial_density_g
+
         uc = ucm.UnitCell(
             lattice=lat, atom_types=uc0.atom_types, type_of_atom=uc0.type_of_atom,
             positions=np.mod(positions, 1.0), moments=uc0.moments,
@@ -41,7 +46,17 @@ def relax_atoms(
             c = cm.SimulationContext.create(cfg, base_dir)
         finally:
             ucm.UnitCell.from_config = orig
-        return run_scf(cfg, ctx=c)
+        rho_at = initial_density_g(c)
+        state = warm["state"]
+        if state is not None:
+            # delta-density extrapolation across the geometry step (QE-style):
+            # carry the bonding rearrangement, move the atomic superposition
+            state = dict(state)
+            state["rho_g"] = state["rho_g"] - warm["rho_at"] + rho_at
+        out = run_scf(cfg, ctx=c, initial_state=state, keep_state=True)
+        warm["state"] = out.get("_state")
+        warm["rho_at"] = rho_at
+        return out
 
     # simple BFGS on cartesian coordinates with analytic gradient
     x = (pos @ lat).ravel()

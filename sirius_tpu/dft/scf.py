@@ -92,7 +92,14 @@ def run_scf(
     restart_from: str | None = None,
     save_to: str | None = None,
     ctx: SimulationContext | None = None,
+    initial_state: dict | None = None,
+    keep_state: bool = False,
 ) -> dict:
+    """initial_state: optional in-memory warm start {rho_g, mag_g, psi}
+    (e.g. the `_state` of a previous run_scf at nearby atomic positions,
+    used by relax/vcrelax between geometry steps). keep_state: attach that
+    state to the result as `_state` (costs a host copy of all wave
+    functions; only geometry drivers ask for it)."""
     t0 = time.time()
     from sirius_tpu.utils.profiler import reset_timers
 
@@ -159,8 +166,21 @@ def run_scf(
         rho_g = state["rho_g"]
         if polarized:
             mag_g = state.get("mag_g", mag_g)
+    psi = None
+    if initial_state is not None:
+        rho_g = np.asarray(initial_state["rho_g"])
+        if polarized and initial_state.get("mag_g") is not None:
+            mag_g = np.asarray(initial_state["mag_g"])
+        prev_psi = initial_state.get("psi")
+        if prev_psi is not None and prev_psi.shape == (
+            nk, ns, nb, ctx.gkvec.ngk_max,
+        ):
+            psi = jnp.asarray(prev_psi) * jnp.asarray(
+                ctx.gkvec.mask[:, None, None, :]
+            )
     pot = generate_potential(ctx, rho_g, xc, mag_g)
-    psi = _initial_subspace(ctx)
+    if psi is None:
+        psi = _initial_subspace(ctx)
     om_size = 0 if hub is None else ns * hub.num_hub_total * hub.num_hub_total
     mixer = Mixer(
         cfg.mixer, ctx.gvec.glen2,
@@ -260,10 +280,12 @@ def run_scf(
                     evals[ik, ispn] = np.asarray(ev)
                     per_spin.append(x)
                 new_psi.append(jnp.stack(per_spin))
-            # H*psi application count: davidson applies H to the initial
-            # block once and to the 3nb subspace each step (reference
-            # num_loc_op_applied counter)
-            counters["num_loc_op_applied"] += nk * ns * nb * (2 + 3 * itsol.num_steps)
+            # H*psi application count (reference num_loc_op_applied counter)
+            from sirius_tpu.solvers.davidson import num_applies
+
+            counters["num_loc_op_applied"] += nk * ns * num_applies(
+                itsol.num_steps, nb
+            )
         psi = jnp.stack(new_psi)
 
         # --- occupations ---
@@ -431,6 +453,13 @@ def run_scf(
     }
     if hub is not None:
         result["_hubbard_v"] = vhub  # ndarray, consumed by the band-path task
+    if keep_state:
+        # in-memory state for warm starts across geometry steps
+        result["_state"] = {
+            "rho_g": np.asarray(rho_g),
+            "mag_g": None if mag_g is None else np.asarray(mag_g),
+            "psi": np.asarray(psi),
+        }
     if polarized:
         result["magnetisation"] = {
             "total": [0.0, 0.0, float(np.real(mag_g[0]) * ctx.unit_cell.omega)],
@@ -560,6 +589,7 @@ def run_scf_from_file(
     else:  # ground_state_new
         result = run_scf(cfg, base_dir, save_to=state_file)
     result.pop("_hubbard_v", None)  # ndarray, not JSON-serializable
+    result.pop("_state", None)
     out = {
         "ground_state": result,
         "task": task,

@@ -70,11 +70,13 @@ def _lda_c_pw_e(nu: jnp.ndarray, nd: jnp.ndarray) -> jnp.ndarray:
     rs = (3.0 / (4.0 * jnp.pi * n)) ** (1.0 / 3.0)
     ec0 = _pw92_g(rs, 0.031091, 0.21370, 7.5957, 3.5876, 1.6382, 0.49294)
     ec1 = _pw92_g(rs, 0.015545, 0.20548, 14.1189, 6.1977, 3.3662, 0.62517)
+    # alpha_c(rs) = -G(fit): the PW92 spin-stiffness fit parametrizes -alpha_c,
+    # so mac (= alpha_c) enters the interpolation with a POSITIVE sign.
     mac = -_pw92_g(rs, 0.016887, 0.11125, 10.357, 3.6231, 0.88026, 0.49671)
     fz = _zeta_f(zeta)
     fpp0 = 8.0 / (9.0 * (2.0 ** (4.0 / 3.0) - 2.0))
     z4 = zeta**4
-    eps = ec0 + (-mac) * fz / fpp0 * (1 - z4) + (ec1 - ec0) * fz * z4
+    eps = ec0 + mac * fz / fpp0 * (1 - z4) + (ec1 - ec0) * fz * z4
     return n * eps
 
 

@@ -13,8 +13,16 @@ LOBPCG-style iteration with a constant 3*nb subspace [X, K R, P]:
      directions are projected out, not crashed on
   4. X' = V C_low, P' = V C_low minus the X-block contribution
 
-Every step is dense batched linear algebra (MXU) + the caller's H/S applies;
-the iteration count is static (config iterative_solver.num_steps).
+Every step is dense batched linear algebra (MXU) + ONE H/S application to
+the new preconditioned-residual block: H X and H P are carried through the
+scan and updated by the same linear combinations as X and P (the reference
+likewise applies H only to the newly-added subspace block per iteration,
+davidson.hpp:751-801). In single precision the carried blocks drift and the
+Rayleigh-Ritz step amplifies the inconsistency (variational feedback), so
+every `refresh_every` steps the carried H X / H P are recomputed with a true
+application (chunked scan, still ~3x fewer H applies than re-applying to the
+full 3nb subspace each step). The iteration count is static (config
+iterative_solver.num_steps).
 """
 
 from __future__ import annotations
@@ -23,6 +31,18 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+# refresh cadence of the carried H X / H P blocks; scf.py's H-application
+# counter derives from this, keep them in sync via this constant
+REFRESH_EVERY = 5
+
+
+def num_applies(num_steps: int, nb: int, refresh_every: int = REFRESH_EVERY) -> int:
+    """H-applications (in band rows) of one davidson() call: nb at the first
+    boundary (P still zero), 2nb at later chunk boundaries, nb per step for
+    the new block, nb on exit."""
+    nchunks = -(-num_steps // refresh_every)
+    return nb * (num_steps + 2 * nchunks)
 
 
 def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6):
@@ -49,7 +69,7 @@ def _precondition(r: jax.Array, h_diag: jax.Array, o_diag: jax.Array, eval_: jax
     return r / p
 
 
-@partial(jax.jit, static_argnames=("apply_fn", "num_steps"))
+@partial(jax.jit, static_argnames=("apply_fn", "num_steps", "refresh_every"))
 def davidson(
     apply_fn,  # (params, psi [nb, ng]) -> (h psi, s psi); a STABLE module-
     # level function — closures would retrace the jit per call site
@@ -60,6 +80,7 @@ def davidson(
     mask: jax.Array,  # [ng] valid-G mask
     num_steps: int = 20,
     res_tol: float = 1e-6,
+    refresh_every: int = REFRESH_EVERY,
 ):
     """Returns (eval [nb], X [nb, ng], res_norms [nb])."""
     nb = x0.shape[0]
@@ -77,36 +98,59 @@ def davidson(
     x = ortho(x0 * mask)
 
     def step(carry, _):
-        x, p, evals = carry
-        hx, sx = apply_h_s(x)
-        # Ritz values of current block
+        x, hx, sx, p, hp, sp = carry
+        # Ritz values of current block (H X, S X carried, no re-application)
         evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
         r = (hx - evals[:, None] * sx) * mask
         rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(r) ** 2, axis=1)))
         conv = rnorm < res_tol
-        r = jnp.where(conv[:, None], 0.0, _precondition(r, h_diag, o_diag, evals)) * mask
+        w = jnp.where(conv[:, None], 0.0, _precondition(r, h_diag, o_diag, evals)) * mask
         # project out X and normalize rows: keeps the 3nb overlap matrix
         # well-conditioned so the rank-revealing cutoff doesn't stall
         # convergence near the solution
-        r = r - (r @ x.conj().T) @ x
-        r = r / jnp.maximum(jnp.linalg.norm(r, axis=1, keepdims=True), 1e-30)
-        p = p / jnp.maximum(jnp.linalg.norm(p, axis=1, keepdims=True), 1e-30)
-        v = jnp.concatenate([x, r, p], axis=0)  # (3nb, ng)
-        hv, sv = apply_h_s(v)
+        w = w - (w @ x.conj().T) @ x
+        w = w / jnp.maximum(jnp.linalg.norm(w, axis=1, keepdims=True), 1e-30)
+        # the ONLY H/S application of the step: the new block
+        hw, sw = apply_h_s(w)
+        v = jnp.concatenate([x, w, p], axis=0)  # (3nb, ng)
+        hv = jnp.concatenate([hx, hw, hp], axis=0)
+        sv = jnp.concatenate([sx, sw, sp], axis=0)
         hsub = v.conj() @ hv.T
         ssub = v.conj() @ sv.T
         hsub = 0.5 * (hsub + hsub.conj().T)
         ssub = 0.5 * (ssub + ssub.conj().T)
         e, c = _rayleigh_ritz(hsub, ssub, nb)
+        # X' = V C and the carried H X' = (H V) C, S X' = (S V) C exactly
         xn = (c.T @ v) * mask
-        # new search direction: the non-X part of the update
+        hxn = (c.T @ hv) * mask
+        sxn = (c.T @ sv) * mask
+        # new search direction: the non-X part of the update (row-normalized,
+        # with the same scale applied to the carried H P / S P)
         cp = c.at[:nb, :].set(0.0)
         pn = (cp.T @ v) * mask
-        return (xn, pn, e), rnorm
+        pscale = 1.0 / jnp.maximum(jnp.linalg.norm(pn, axis=1, keepdims=True), 1e-30)
+        return (xn, hxn, sxn, pn * pscale, (cp.T @ hv) * mask * pscale,
+                (cp.T @ sv) * mask * pscale), rnorm
 
-    (x, p, evals), rhist = jax.lax.scan(
-        step, (x, jnp.zeros_like(x), jnp.zeros(nb, x0.real.dtype)), None, length=num_steps
-    )
+    z = jnp.zeros_like(x)
+    p, hp, sp = z, z, z
+    done = 0
+    while done < num_steps:
+        steps = min(refresh_every, num_steps - done)
+        if done == 0:
+            # P is exactly zero before the first chunk: only X needs applying
+            hx, sx = apply_h_s(x)
+        else:
+            # chunk-boundary refresh: true H/S application to [X; P]
+            hxp, sxp = apply_h_s(jnp.concatenate([x, p], axis=0))
+            hx, sx = hxp[:nb], sxp[:nb]
+            hp, sp = hxp[nb:], sxp[nb:]
+        (x, hx, sx, p, hp, sp), rhist = jax.lax.scan(
+            step, (x, hx, sx, p, hp, sp), None, length=steps
+        )
+        done += steps
+    # fresh application for the exit values: the carried H X accumulates
+    # linear-combination rounding (matters in c64)
     hx, sx = apply_h_s(x)
     evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
     rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(hx - evals[:, None] * sx) ** 2, axis=1)))

@@ -26,7 +26,8 @@ def test_electron_count_conserved(kind):
     mu, occ, ent = find_fermi(evals, w, nel, 0.05, kind=kind)
     n = float(jnp.sum(w[:, None, None] * occ))
     np.testing.assert_allclose(n, nel, atol=1e-8)
-    assert float(ent) <= 1e-12  # entropy term is negative
+    if kind != "methfessel_paxton":  # MP1 entropy is not negative-definite
+        assert float(ent) <= 1e-12
 
 
 def test_occupancy_limits_and_monotonic():
@@ -36,6 +37,31 @@ def test_occupancy_limits_and_monotonic():
         assert abs(f[0]) < 1e-8 and abs(f[-1] - 1) < 1e-8
         if kind in ("gaussian", "fermi_dirac"):
             assert np.all(np.diff(f) >= -1e-12)
+
+
+def test_methfessel_paxton_known_value():
+    # f(t=0.5) = 0.5(1+erf(0.5)) + 2*0.5*e^{-0.25}/(4 sqrt(pi)) ≈ 0.870098
+    # (QE wgauss, ngauss=1). Round-1 had this term subtracted (ADVICE r1).
+    f = float(occupancy("methfessel_paxton", jnp.array([0.5]), 1.0)[0])
+    np.testing.assert_allclose(f, 0.870098, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["gaussian", "fermi_dirac", "cold", "methfessel_paxton"])
+def test_entropy_occupancy_thermodynamic_consistency(kind):
+    # For any smearing, s'(x) = x f'(x) with x = mu - eps (this is what makes
+    # F = E + S variational); checked by central finite differences. Catches
+    # any relative sign error between occupancy and entropy_term.
+    w = 0.07
+    xs = np.linspace(-0.25, 0.25, 21)
+    h = 1e-6
+    for x in xs:
+        fp = float(occupancy(kind, jnp.array([x + h]), w)[0])
+        fm = float(occupancy(kind, jnp.array([x - h]), w)[0])
+        sp = float(entropy_term(kind, jnp.array([x + h]), w)[0])
+        sm = float(entropy_term(kind, jnp.array([x - h]), w)[0])
+        dfdx = (fp - fm) / (2 * h)
+        dsdx = (sp - sm) / (2 * h)
+        np.testing.assert_allclose(dsdx, x * dfdx, rtol=2e-5, atol=1e-8)
 
 
 def test_fermi_dirac_entropy_analytic():

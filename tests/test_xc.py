@@ -39,6 +39,33 @@ def test_lda_c_pw_known_value():
     np.testing.assert_allclose(float(out["e"][0]) / rho, -0.04476, rtol=1e-3)
 
 
+def _eps_c(names, rs, zeta):
+    n = 3 / (4 * np.pi * rs**3)
+    nu = jnp.array([0.5 * n * (1 + zeta)])
+    nd = jnp.array([0.5 * n * (1 - zeta)])
+    out = XCFunctional(names).evaluate_polarized(nu, nd)
+    return float(out["e"][0]) / n
+
+
+def test_lda_c_pw_intermediate_zeta_matches_pz():
+    # PW92 and PZ81 fit the same QMC data; at intermediate polarization they
+    # agree to better than ~1e-3 Ha/e. Round-1 had the spin-stiffness sign
+    # flipped, which broke this by up to 0.014 Ha/e (ADVICE r1).
+    for rs in (1.0, 2.0, 5.0):
+        for zeta in (0.3, 0.5, 0.8):
+            pw = _eps_c(["XC_LDA_C_PW"], rs, zeta)
+            pz = _eps_c(["XC_LDA_C_PZ"], rs, zeta)
+            assert abs(pw - pz) < 2.5e-3, (rs, zeta, pw, pz)
+
+
+def test_lda_c_pw_monotonic_in_polarization():
+    # |eps_c| decreases with polarization: eps_c(zeta) is monotonically
+    # increasing (toward less negative) on zeta in [0, 1].
+    for rs in (0.5, 2.0, 10.0):
+        eps = [_eps_c(["XC_LDA_C_PW"], rs, z) for z in np.linspace(0.0, 1.0, 11)]
+        assert np.all(np.diff(eps) > 0), (rs, eps)
+
+
 @pytest.mark.parametrize("names", [["XC_LDA_X", "XC_LDA_C_PZ"], ["XC_LDA_C_PW"]])
 def test_vxc_matches_finite_difference(names):
     xc = XCFunctional(names)

@@ -1,0 +1,101 @@
+"""Progressive TPU compile-service probes (run each stage in a subprocess
+with a timeout; see .claude memory: the remote compile helper can wedge
+permanently if a long compile is killed, so escalate program size slowly).
+
+Usage: python tools/tpu_probe.py <stage>
+  stage 0: trivial f32 jit
+  stage 1: c64 fft+matmul inside jit
+  stage 2: apply_h_s on the bench shapes
+  stage 3: eigh c64 (78x78, the Rayleigh-Ritz size) inside jit
+  stage 4: one davidson step (scan length=1) on bench shapes
+  stage 5: full 20-step davidson_kset on bench shapes
+"""
+
+import sys
+import time
+
+
+def main(stage: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    dev = jax.devices()[0]
+    print(f"[{time.time()-t0:6.1f}s] devices: {dev}", flush=True)
+
+    if stage == 0:
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        y = f(jnp.ones((128, 128), jnp.float32))
+        jax.block_until_ready(y)
+    elif stage == 1:
+        def g(xr, xi):
+            x = (xr + 1j * xi).astype(jnp.complex64)
+            y = jnp.fft.fftn(x, axes=(-2, -1))
+            z = y @ y.conj().T
+            return jnp.real(z), jnp.imag(z)
+
+        f = jax.jit(g)
+        y = f(jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32))
+        jax.block_until_ready(y)
+    elif stage == 2:
+        from sirius_tpu.parallel.batched import make_hkset_params
+        from sirius_tpu.ops.hamiltonian import HkParams, apply_h_s
+        from sirius_tpu.testing import synthetic_silicon_context
+
+        ctx = synthetic_silicon_context(
+            gk_cutoff=6.0, pw_cutoff=20.0, ngridk=(1, 1, 1), num_bands=26,
+            use_symmetry=False,
+        )
+        ps = make_hkset_params(ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64)
+        pk = HkParams(
+            veff_r=ps.veff_r, ekin=ps.ekin[0], mask=ps.mask[0],
+            fft_index=ps.fft_index[0], beta=ps.beta[0], dion=ps.dion, qmat=ps.qmat,
+        )
+
+        @jax.jit
+        def f(pr, pi):
+            h, s = apply_h_s(pk, (pr + 1j * pi).astype(jnp.complex64))
+            return jnp.real(h), jnp.imag(h)
+
+        ngk = ctx.gkvec.ngk_max
+        y = f(jnp.ones((26, ngk), jnp.float32), jnp.ones((26, ngk), jnp.float32))
+        jax.block_until_ready(y)
+    elif stage == 3:
+        @jax.jit
+        def f(ar, ai):
+            a = (ar + 1j * ai).astype(jnp.complex64)
+            a = a + a.conj().T
+            w, v = jnp.linalg.eigh(a)
+            return w, jnp.real(v)
+
+        rng = np.random.default_rng(0)
+        y = f(
+            jnp.asarray(rng.standard_normal((78, 78)), jnp.float32),
+            jnp.asarray(rng.standard_normal((78, 78)), jnp.float32),
+        )
+        jax.block_until_ready(y)
+    elif stage in (4, 5):
+        from sirius_tpu.parallel.batched import davidson_kset, make_hkset_params
+        from sirius_tpu.testing import synthetic_silicon_context
+
+        ctx = synthetic_silicon_context(
+            gk_cutoff=6.0, pw_cutoff=20.0, ngridk=(1, 1, 1), num_bands=26,
+            use_symmetry=False,
+        )
+        ps = make_hkset_params(ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64)
+        rng = np.random.default_rng(0)
+        ngk = ctx.gkvec.ngk_max
+        psi = (
+            rng.standard_normal((1, 1, 26, ngk)) + 1j * rng.standard_normal((1, 1, 26, ngk))
+        ).astype(np.complex64) * ctx.gkvec.mask[:, None, None, :].astype(np.float32)
+        nsteps = 1 if stage == 4 else 20
+
+        ev, x, rn = davidson_kset(ps, jnp.asarray(psi), num_steps=nsteps)
+        jax.block_until_ready((ev, rn))
+        print(f"[{time.time()-t0:6.1f}s] evals[:4]={np.asarray(ev)[0,0,:4]}", flush=True)
+    print(f"[{time.time()-t0:6.1f}s] stage {stage} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]))
