@@ -46,6 +46,23 @@ def _accumulate_k(
     return jnp.einsum("sb,sbxyz->xyz", occ_w, jnp.abs(fr) ** 2)
 
 
+def density_from_coarse_acc(ctx: SimulationContext, acc: np.ndarray) -> np.ndarray:
+    """Finalize the per-spin density from the occupation-weighted |psi(r)|^2
+    accumulation on the coarse box: divide by Omega, transform to coarse G,
+    map to the fine G set. acc: [nspin, n1, n2, n3] real."""
+    dims = ctx.fft_coarse.dims
+    ns = acc.shape[0]
+    out = np.zeros((ns, ctx.gvec.num_gvec), dtype=np.complex128)
+    for ispn in range(ns):
+        rho_r_coarse = np.asarray(acc[ispn]) / ctx.unit_cell.omega
+        rho_g_coarse = np.asarray(
+            r_to_g(jnp.asarray(rho_r_coarse, dtype=jnp.complex128),
+                   jnp.asarray(ctx.gvec_coarse.fft_index), dims)
+        )
+        out[ispn, ctx.coarse_to_fine] = rho_g_coarse
+    return out
+
+
 def generate_density_g(
     ctx: SimulationContext,
     psi_all: jnp.ndarray,  # [nk, nspin, nb, ngk_max]
@@ -60,22 +77,17 @@ def generate_density_g(
     dims = ctx.fft_coarse.dims
     nk = ctx.gkvec.num_kpoints
     ns = psi_all.shape[1]
-    out = np.zeros((ns, ctx.gvec.num_gvec), dtype=np.complex128)
+    acc = np.zeros((ns,) + tuple(dims))
     for ispn in range(ns):
-        acc = jnp.zeros(dims)
+        a = jnp.zeros(dims)
         for ik in range(nk):
             ow = jnp.asarray(occ[ik, ispn : ispn + 1] * ctx.kweights[ik])
-            acc = acc + _accumulate_k(
+            a = a + _accumulate_k(
                 psi_all[ik, ispn : ispn + 1], ow,
                 jnp.asarray(ctx.gkvec.fft_index[ik]), dims,
             )
-        rho_r_coarse = np.asarray(acc) / ctx.unit_cell.omega
-        rho_g_coarse = np.asarray(
-            r_to_g(jnp.asarray(rho_r_coarse, dtype=jnp.complex128),
-                   jnp.asarray(ctx.gvec_coarse.fft_index), dims)
-        )
-        out[ispn, ctx.coarse_to_fine] = rho_g_coarse
-    return out
+        acc[ispn] = np.asarray(a)
+    return density_from_coarse_acc(ctx, acc)
 
 
 def atomic_sphere_radii(uc, rmax: float = 2.0) -> np.ndarray:

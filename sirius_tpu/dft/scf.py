@@ -36,29 +36,14 @@ from sirius_tpu.solvers.davidson import davidson
 from sirius_tpu.utils.profiler import counters, profile, timer_report
 
 
-@jax.jit
-def _density_matrix_k(beta_gk, psi, occ_w):
-    """n_{xi1 xi2} = sum_{s,b} occ_w conj(<beta_xi1|psi>) <beta_xi2|psi>
-    for one k-point (reference add_k_point_contribution_dm_pwpp,
-    density.cpp:847-901)."""
-    bp = jnp.einsum("xg,sbg->sbx", jnp.conj(beta_gk), psi)
-    return jnp.einsum("sb,sbx,sby->xy", occ_w, jnp.conj(bp), bp)
-
-
 def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
-    """Diagonals of H and S for the preconditioner (reference
-    get_h_o_diag_pw)."""
-    ekin = ctx.gkvec.kinetic()[ik]
-    h = ekin + v0
-    o = np.ones_like(h)
-    if ctx.beta.num_beta_total:
-        b = ctx.beta.beta_gk[ik]
-        h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), dmat, b))
-        if ctx.beta.qmat is not None:
-            o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), ctx.beta.qmat, b))
-    return np.where(ctx.gkvec.mask[ik] > 0, h, 1e4), np.where(
-        ctx.gkvec.mask[ik] > 0, o, 1.0
-    )
+    """Diagonals of H and S for the preconditioner at one k (serial debug
+    path) — same formulas as the production k-set path, by construction."""
+    from sirius_tpu.parallel.batched import compute_h_diag, compute_o_diag
+
+    h = compute_h_diag(ctx, np.asarray(dmat)[None], v0)[ik, 0]
+    o = compute_o_diag(ctx)[ik]
+    return h, o
 
 
 def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
@@ -94,12 +79,15 @@ def run_scf(
     ctx: SimulationContext | None = None,
     initial_state: dict | None = None,
     keep_state: bool = False,
+    serial_bands: bool = False,
 ) -> dict:
     """initial_state: optional in-memory warm start {rho_g, mag_g, psi}
     (e.g. the `_state` of a previous run_scf at nearby atomic positions,
     used by relax/vcrelax between geometry steps). keep_state: attach that
     state to the result as `_state` (costs a host copy of all wave
-    functions; only geometry drivers ask for it)."""
+    functions; only geometry drivers ask for it). serial_bands: use the
+    per-(k, spin) debug path instead of the production one-program batched
+    k-set solve (parallel/batched.py)."""
     t0 = time.time()
     from sirius_tpu.utils.profiler import reset_timers
 
@@ -187,11 +175,50 @@ def run_scf(
         num_components=2 if polarized else 1,
         extra_len=om_size,
     )
-    # constant device tables, uploaded once (not per iteration)
-    beta_dev = [jnp.asarray(ctx.beta.beta_gk[ik]) for ik in range(nk)]
+    # constant device tables, uploaded once (not per iteration); the full-
+    # precision projector stack feeds the density-matrix accumulation
+    # independently of the wave-function working dtype
+    beta_dev = (
+        jnp.asarray(np.asarray(ctx.beta.beta_gk))
+        if ctx.beta.num_beta_total
+        else None
+    )
+    hub_phi_stack = (
+        None if hub is None else np.stack([hub.phi_s_gk[ik] for ik in range(nk)])
+    )
     # per-(k, dtype) Hamiltonian parameter cache: only veff_r/dion change
     # between iterations, everything else is uploaded once via _replace
     _params_cache: dict = {}
+    _kset_cache: dict = {}
+
+    def kset_params(veff_stack, d_stack, v0, vhub_s, dtype):
+        """Batched-path parameters with cached constant tables (only the
+        potential-dependent leaves are re-uploaded per iteration)."""
+        from sirius_tpu.ops.hamiltonian import real_dtype_of
+        from sirius_tpu.parallel.batched import compute_h_diag, make_hkset_params
+
+        rdt = real_dtype_of(dtype)
+        if dtype not in _kset_cache:
+            # a lower-precision entry is dead after the fp32->fp64 polish
+            # switch: evict it so two full projector stacks never coexist
+            for other in list(_kset_cache):
+                if other != dtype:
+                    del _kset_cache[other]
+            _kset_cache[dtype] = make_hkset_params(
+                ctx, veff_stack, d_stack, dtype=dtype, v0=v0,
+                hub_phi=hub_phi_stack, vhub=vhub_s,
+            )
+            return _kset_cache[dtype]
+        h_diag = compute_h_diag(ctx, np.asarray(d_stack), v0)
+        # store the refreshed params back so the previous iteration's
+        # potential-dependent device buffers are released
+        _kset_cache[dtype] = _kset_cache[dtype]._replace(
+            veff_r=jnp.asarray(veff_stack, dtype=rdt),
+            dion=jnp.asarray(d_stack, dtype=rdt),
+            h_diag=jnp.asarray(h_diag, dtype=rdt),
+            vhub=None if vhub_s is None else jnp.asarray(vhub_s, dtype=dtype),
+        )
+        return _kset_cache[dtype]
 
     def hk_params(ik, veff_r, dmat, dtype, vhub_s=None):
         from sirius_tpu.ops.hamiltonian import real_dtype_of
@@ -253,40 +280,56 @@ def run_scf(
                 )
             else:
                 d_by_spin.append(ctx.beta.dion)
-        new_psi = []
+        v0 = float(np.real(pot.veff_g[0]))
         with profile("scf::band_solve"):
-            for ik in range(nk):
-                per_spin = []
-                for ispn in range(ns):
-                    from sirius_tpu.ops.hamiltonian import real_dtype_of
+            if serial_bands:
+                new_psi = []
+                for ik in range(nk):
+                    per_spin = []
+                    for ispn in range(ns):
+                        from sirius_tpu.ops.hamiltonian import real_dtype_of
 
-                    params = hk_params(
-                        ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype,
-                        vhub_s=None if vhub is None else vhub[ispn],
-                    )
-                    v0 = float(np.real(pot.veff_g[0]))
-                    h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
-                    rdt = real_dtype_of(wf_dtype)
-                    ev, x, rn = davidson(
-                        apply_h_s,
-                        params,
-                        psi[ik, ispn].astype(wf_dtype),
-                        jnp.asarray(h_diag, dtype=rdt),
-                        jnp.asarray(o_diag, dtype=rdt),
-                        params.mask,
-                        num_steps=itsol.num_steps,
-                        res_tol=itsol.residual_tolerance,
-                    )
-                    evals[ik, ispn] = np.asarray(ev)
-                    per_spin.append(x)
-                new_psi.append(jnp.stack(per_spin))
+                        params = hk_params(
+                            ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype,
+                            vhub_s=None if vhub is None else vhub[ispn],
+                        )
+                        h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
+                        rdt = real_dtype_of(wf_dtype)
+                        ev, x, rn = davidson(
+                            apply_h_s,
+                            params,
+                            psi[ik, ispn].astype(wf_dtype),
+                            jnp.asarray(h_diag, dtype=rdt),
+                            jnp.asarray(o_diag, dtype=rdt),
+                            params.mask,
+                            num_steps=itsol.num_steps,
+                            res_tol=itsol.residual_tolerance,
+                        )
+                        evals[ik, ispn] = np.asarray(ev)
+                        per_spin.append(x)
+                    new_psi.append(jnp.stack(per_spin))
+                psi = jnp.stack(new_psi)
+            else:
+                # production path: the whole (k, spin) set as ONE program
+                # (parallel/batched.py; shards over the ("k", "b") mesh)
+                from sirius_tpu.parallel.batched import davidson_kset
+
+                ps = kset_params(
+                    pot.veff_r_coarse[:ns], np.stack(d_by_spin), v0, vhub,
+                    wf_dtype,
+                )
+                ev, psi, rn = davidson_kset(
+                    ps, psi.astype(wf_dtype),
+                    num_steps=itsol.num_steps,
+                    res_tol=itsol.residual_tolerance,
+                )
+                evals = np.asarray(ev, dtype=np.float64)
             # H*psi application count (reference num_loc_op_applied counter)
             from sirius_tpu.solvers.davidson import num_applies
 
             counters["num_loc_op_applied"] += nk * ns * num_applies(
                 itsol.num_steps, nb
             )
-        psi = jnp.stack(new_psi)
 
         # --- occupations ---
         mu, occ, entropy_sum = find_fermi(
@@ -312,22 +355,25 @@ def run_scf(
             )
 
         # --- density (per spin, then charge/magnetization assembly) ---
+        occ_w = jnp.asarray(occ_np * ctx.kweights[:, None, None])
         with profile("scf::density"):
-            rho_spin = generate_density_g(ctx, psi, occ_np)
+            if serial_bands:
+                rho_spin = generate_density_g(ctx, psi, occ_np)
+            else:
+                from sirius_tpu.dft.density import density_from_coarse_acc
+                from sirius_tpu.parallel.batched import density_kset
+
+                rho_spin = density_from_coarse_acc(
+                    ctx, np.asarray(density_kset(ps, psi, occ_w))
+                )
         dm_blocks_by_spin = []
         if ctx.aug is not None:
+            from sirius_tpu.parallel.batched import density_matrix_kset
+
+            dm_by_spin = np.asarray(density_matrix_kset(beta_dev, psi, occ_w))
             for ispn in range(ns):
-                dm_full = np.zeros(
-                    (ctx.beta.num_beta_total, ctx.beta.num_beta_total),
-                    dtype=np.complex128,
-                )
-                for ik in range(nk):
-                    ow = jnp.asarray(occ_np[ik, ispn : ispn + 1] * ctx.kweights[ik])
-                    dm_full += np.asarray(
-                        _density_matrix_k(beta_dev[ik], psi[ik, ispn : ispn + 1], ow)
-                    )
                 dm_blocks = [
-                    dm_full[off : off + nbf, off : off + nbf]
+                    dm_by_spin[ispn, off : off + nbf, off : off + nbf]
                     for _, off, nbf in ctx.beta.atom_blocks(ctx.unit_cell)
                 ]
                 dm_blocks_by_spin.append(dm_blocks)

@@ -1,4 +1,4 @@
-"""K-set-batched band solve: the whole k-point loop as ONE jitted/vmapped
+"""K-set-batched band solve: the whole (k, spin) loop as ONE jitted/vmapped
 computation, shardable over the ("k", "b") mesh.
 
 The reference loops local k-points serially per MPI rank
@@ -6,6 +6,10 @@ The reference loops local k-points serially per MPI rank
 make the entire k-set one vmapped davidson call — a single XLA program that
 shards over the mesh with zero hand-written collectives (density reduction
 over "k" is a psum XLA inserts from the einsum).
+
+This is the PRODUCTION band-solve path: dft/scf.run_scf drives it each SCF
+iteration with the per-spin screened D matrices and Hubbard potentials
+batched in (a serial per-(k, spin) fallback remains for debugging).
 """
 
 from __future__ import annotations
@@ -22,50 +26,115 @@ from sirius_tpu.solvers.davidson import davidson
 
 
 class HkSetParams(NamedTuple):
-    """Batched-over-k Hamiltonian data (leading axis nk on per-k leaves)."""
+    """Batched-over-(k, spin) Hamiltonian data.
 
-    veff_r: jax.Array  # [n1,n2,n3] shared
+    Per-k leaves carry a leading nk axis; spin-dependent leaves (potential,
+    screened D, Hubbard V) carry an ns axis. ns == num_spins of the run
+    (1 for unpolarized, 2 collinear)."""
+
+    veff_r: jax.Array  # [ns, n1,n2,n3] effective potential per spin channel
     ekin: jax.Array  # [nk, ngk]
     mask: jax.Array  # [nk, ngk]
     fft_index: jax.Array  # [nk, ngk]
     beta: jax.Array  # [nk, nbeta, ngk]
-    dion: jax.Array  # [nbeta, nbeta] shared
+    dion: jax.Array  # [ns, nbeta, nbeta] screened D per spin
     qmat: jax.Array  # [nbeta, nbeta] shared
-    h_diag: jax.Array  # [nk, ngk]
-    o_diag: jax.Array  # [nk, ngk]
+    h_diag: jax.Array  # [nk, ns, ngk]
+    o_diag: jax.Array  # [nk, ngk] (S is spin-independent)
+    hub: jax.Array = None  # [nk, nhub, ngk] S-weighted Hubbard orbitals
+    vhub: jax.Array = None  # [ns, nhub, nhub]
+
+
+def compute_h_diag(ctx, dion, v0: float = 0.0):
+    """h_diag [nk, ns, ngk]: H preconditioner diagonal for the whole k-set
+    (reference get_h_o_diag_pw); changes every SCF iteration with the
+    screened D. dion: [ns, nbeta, nbeta]."""
+    nbeta = ctx.beta.num_beta_total
+    nk = ctx.gkvec.num_kpoints
+    ns = dion.shape[0]
+    ekin = ctx.gkvec.kinetic()
+    h_diag = np.empty((nk, ns, ctx.gkvec.ngk_max))
+    for ik in range(nk):
+        b = ctx.beta.beta_gk[ik]
+        for ispn in range(ns):
+            h = ekin[ik] + v0
+            if nbeta:
+                h = h + np.real(
+                    np.einsum("xg,xy,yg->g", np.conj(b), dion[ispn], b)
+                )
+            h_diag[ik, ispn] = np.where(ctx.gkvec.mask[ik] > 0, h, 1e4)
+    return h_diag
+
+
+def compute_o_diag(ctx):
+    """o_diag [nk, ngk]: S preconditioner diagonal; potential-independent
+    (only the constant augmentation Q enters), computed once per run."""
+    nbeta = ctx.beta.num_beta_total
+    nk = ctx.gkvec.num_kpoints
+    qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros((nbeta, nbeta))
+    o_diag = np.empty((nk, ctx.gkvec.ngk_max))
+    for ik in range(nk):
+        o = np.ones(ctx.gkvec.ngk_max)
+        if nbeta:
+            b = ctx.beta.beta_gk[ik]
+            o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), qmat, b))
+        o_diag[ik] = np.where(ctx.gkvec.mask[ik] > 0, o, 1.0)
+    return o_diag
+
+
+def hkset_slice(params: HkSetParams, ik: int = 0, ispn: int = 0) -> HkParams:
+    """Single-(k, spin) HkParams view of a batched HkSetParams (used by the
+    bench/probe/entry micro-workloads; Hubbard leaves carried along)."""
+    return HkParams(
+        veff_r=params.veff_r[ispn],
+        ekin=params.ekin[ik],
+        mask=params.mask[ik],
+        fft_index=params.fft_index[ik],
+        beta=params.beta[ik],
+        dion=params.dion[ispn],
+        qmat=params.qmat,
+        hub=None if params.hub is None else params.hub[ik],
+        vhub=None if params.vhub is None else params.vhub[ispn],
+    )
 
 
 def make_hkset_params(
-    ctx, veff_r_coarse, d_full=None, dtype=jnp.complex128, v0: float = 0.0
+    ctx,
+    veff_r_coarse,
+    d_full=None,
+    dtype=jnp.complex128,
+    v0: float = 0.0,
+    hub_phi=None,
+    vhub=None,
 ) -> HkSetParams:
-    """v0: average effective potential veff(G=0), included in the
-    preconditioner diagonal exactly like the serial path (_h_o_diag)."""
+    """veff_r_coarse: [n1,n2,n3] or [ns, n1,n2,n3]; d_full: [nbeta,nbeta] or
+    [ns,nbeta,nbeta] screened D (defaults to the bare dion); v0: average
+    effective potential veff(G=0), included in the preconditioner diagonal
+    exactly like the serial path (_h_o_diag)."""
+    from sirius_tpu.ops.hamiltonian import real_dtype_of
+
     nbeta = ctx.beta.num_beta_total
     nk = ctx.gkvec.num_kpoints
-    dion = ctx.beta.dion if d_full is None else d_full
+    veff = np.asarray(veff_r_coarse)
+    if veff.ndim == 3:
+        veff = veff[None]
+    ns = veff.shape[0]
+    dion = ctx.beta.dion if d_full is None else np.asarray(d_full)
+    if dion.ndim == 2:
+        dion = np.broadcast_to(dion, (ns,) + dion.shape)
     qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros((nbeta, nbeta))
-    from sirius_tpu.ops.hamiltonian import real_dtype_of
 
     rdtype = real_dtype_of(dtype)
     ekin = ctx.gkvec.kinetic()
-    h_diag = np.empty((nk, ctx.gkvec.ngk_max))
-    o_diag = np.empty_like(h_diag)
-    for ik in range(nk):
-        b = ctx.beta.beta_gk[ik]
-        h = ekin[ik] + v0
-        o = np.ones_like(h)
-        if nbeta:
-            h = h + np.real(np.einsum("xg,xy,yg->g", np.conj(b), dion, b))
-            o = o + np.real(np.einsum("xg,xy,yg->g", np.conj(b), qmat, b))
-        h_diag[ik] = np.where(ctx.gkvec.mask[ik] > 0, h, 1e4)
-        o_diag[ik] = np.where(ctx.gkvec.mask[ik] > 0, o, 1.0)
+    h_diag = compute_h_diag(ctx, dion, v0)
+    o_diag = compute_o_diag(ctx)
     beta = (
         ctx.beta.beta_gk
         if nbeta
         else np.zeros((nk, 0, ctx.gkvec.ngk_max), dtype=np.complex128)
     )
     return HkSetParams(
-        veff_r=jnp.asarray(veff_r_coarse, dtype=rdtype),
+        veff_r=jnp.asarray(veff, dtype=rdtype),
         ekin=jnp.asarray(ekin, dtype=rdtype),
         mask=jnp.asarray(ctx.gkvec.mask, dtype=rdtype),
         fft_index=jnp.asarray(ctx.gkvec.fft_index),
@@ -74,6 +143,8 @@ def make_hkset_params(
         qmat=jnp.asarray(qmat, dtype=rdtype),
         h_diag=jnp.asarray(h_diag, dtype=rdtype),
         o_diag=jnp.asarray(o_diag, dtype=rdtype),
+        hub=None if hub_phi is None else jnp.asarray(hub_phi, dtype=dtype),
+        vhub=None if vhub is None else jnp.asarray(vhub, dtype=dtype),
     )
 
 
@@ -91,41 +162,64 @@ def davidson_kset(params: HkSetParams, psi, num_steps: int = 20, res_tol: float 
     psi: [nk, ns, nb, ngk] -> (evals [nk, ns, nb], psi', rnorm [nk, ns, nb]).
     """
 
-    def one_k(ekin, mask, fft_index, beta, h_diag, o_diag, psi_k):
-        pk = HkParams(
-            veff_r=params.veff_r,
-            ekin=ekin,
-            mask=mask,
-            fft_index=fft_index,
-            beta=beta,
-            dion=params.dion,
-            qmat=params.qmat,
+    def one_k(ekin, mask, fft_index, beta, h_diag_k, o_diag, hub_k, psi_k):
+        def one_spin(veff_s, dion_s, vhub_s, h_diag_s, x0):
+            pk = HkParams(
+                veff_r=veff_s,
+                ekin=ekin,
+                mask=mask,
+                fft_index=fft_index,
+                beta=beta,
+                dion=dion_s,
+                qmat=params.qmat,
+                hub=hub_k,
+                vhub=vhub_s,
+            )
+            return _davidson_one_k(pk, h_diag_s, o_diag, x0, num_steps, res_tol)
+
+        return jax.vmap(one_spin)(
+            params.veff_r, params.dion, params.vhub, h_diag_k, psi_k
         )
 
-        def one_spin(x0):
-            return _davidson_one_k(pk, h_diag, o_diag, x0, num_steps, res_tol)
-
-        return jax.vmap(one_spin)(psi_k)
-
-    return jax.vmap(one_k)(
+    return jax.vmap(
+        one_k,
+        in_axes=(0, 0, 0, 0, 0, 0, None if params.hub is None else 0, 0),
+    )(
         params.ekin, params.mask, params.fft_index, params.beta,
-        params.h_diag, params.o_diag, psi,
+        params.h_diag, params.o_diag, params.hub, psi,
     )
 
 
 @jax.jit
 def density_kset(params: HkSetParams, psi, occ_w):
-    """Coarse-box density sum_{k,s,b} occ_w |psi(r)|^2 — contracts over the
-    whole k-set in one program (psum over "k" under sharding).
+    """Coarse-box density sum_{k,b} occ_w |psi(r)|^2 per spin — contracts
+    over the whole k-set in one program (psum over "k" under sharding).
 
-    occ_w: [nk, ns, nb] occupation x k-weight."""
-    dims = params.veff_r.shape
+    occ_w: [nk, ns, nb] occupation x k-weight. Returns [ns, n1, n2, n3]."""
+    dims = params.veff_r.shape[-3:]
     n = dims[0] * dims[1] * dims[2]
 
     def one_k(fft_index, psi_k, ow):
         batch = psi_k.shape[:-1]
         box = jnp.zeros(batch + (n,), dtype=psi_k.dtype).at[..., fft_index].add(psi_k)
         fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1)) * n
-        return jnp.einsum("sb,sbxyz->xyz", ow, jnp.abs(fr) ** 2)
+        return jnp.einsum("sb,sbxyz->sxyz", ow, jnp.abs(fr) ** 2)
 
     return jnp.sum(jax.vmap(one_k)(params.fft_index, psi, occ_w), axis=0)
+
+
+@jax.jit
+def density_matrix_kset(beta, psi, occ_w):
+    """Non-local density matrix n^sigma_{xi xi'} = sum_{k,b} occ_w
+    conj(<beta_xi|psi>) <beta_xi'|psi>, contracted over the whole k-set
+    (reference add_k_point_contribution_dm_pwpp, density.cpp:847-901).
+
+    beta: [nk, nbeta, ngk] projector tables (pass the full-precision c128
+    stack so the accumulation precision is independent of the wave-function
+    working dtype). Returns [ns, nbeta, nbeta]."""
+
+    def one_k(beta_k, psi_k, ow):
+        bp = jnp.einsum("xg,sbg->sbx", jnp.conj(beta_k), psi_k)
+        return jnp.einsum("sb,sbx,sby->sxy", ow, jnp.conj(bp), bp)
+
+    return jnp.sum(jax.vmap(one_k)(beta, psi, occ_w), axis=0)

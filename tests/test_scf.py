@@ -44,3 +44,32 @@ def test_scf_h_atom_test23():
             ref["energy"][term],
         )
     assert abs(res["efermi"] - ref["efermi"]) < 1e-6
+
+
+def test_batched_kset_path_matches_serial():
+    """The production one-program (k, spin)-batched band solve must produce
+    the same ground state as the per-(k, spin) debug path (VERDICT r1: the
+    validated path and the benched/sharded path must be the same program)."""
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    def make():
+        return synthetic_silicon_context(
+            gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(2, 2, 2), num_bands=8,
+            ultrasoft=True, use_symmetry=False,
+            extra_params={"num_dft_iter": 12, "density_tol": 1e-8,
+                          "energy_tol": 1e-9},
+        )
+
+    ctx_a = make()
+    res_b = run_scf(ctx_a.cfg, ctx=ctx_a)
+    ctx_s = make()
+    res_s = run_scf(ctx_s.cfg, ctx=ctx_s, serial_bands=True)
+    assert res_b["converged"] and res_s["converged"]
+    for term in ("total", "eval_sum", "vha", "exc"):
+        assert abs(res_b["energy"][term] - res_s["energy"][term]) < 1e-7, term
+    np.testing.assert_allclose(
+        np.asarray(res_b["band_energies"]),
+        np.asarray(res_s["band_energies"]),
+        atol=1e-6,
+    )

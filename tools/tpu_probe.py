@@ -39,8 +39,8 @@ def main(stage: int) -> None:
         y = f(jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32))
         jax.block_until_ready(y)
     elif stage == 2:
-        from sirius_tpu.parallel.batched import make_hkset_params
-        from sirius_tpu.ops.hamiltonian import HkParams, apply_h_s
+        from sirius_tpu.parallel.batched import hkset_slice, make_hkset_params
+        from sirius_tpu.ops.hamiltonian import apply_h_s
         from sirius_tpu.testing import synthetic_silicon_context
 
         ctx = synthetic_silicon_context(
@@ -48,10 +48,7 @@ def main(stage: int) -> None:
             use_symmetry=False,
         )
         ps = make_hkset_params(ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64)
-        pk = HkParams(
-            veff_r=ps.veff_r, ekin=ps.ekin[0], mask=ps.mask[0],
-            fft_index=ps.fft_index[0], beta=ps.beta[0], dion=ps.dion, qmat=ps.qmat,
-        )
+        pk = hkset_slice(ps)
 
         @jax.jit
         def f(pr, pi):
