@@ -68,6 +68,8 @@ class AtomType:
     rho_core: np.ndarray | None  # core charge density rho_core(r)
     core_correction: bool
     paw: dict | None = None
+    paw_core_energy: float = 0.0
+    cutoff_radius_index: int | None = None  # PAW partial-wave truncation
 
     @property
     def num_beta(self) -> int:
@@ -156,4 +158,8 @@ class AtomType:
             rho_core=np.asarray(rho_core, dtype=np.float64)[:nr] if rho_core is not None else None,
             core_correction=bool(h.get("core_correction", False)),
             paw=pp.get("paw_data"),
+            paw_core_energy=float(h.get("paw_core_energy", 0.0)),
+            cutoff_radius_index=(
+                int(h["cutoff_radius_index"]) if "cutoff_radius_index" in h else None
+            ),
         )

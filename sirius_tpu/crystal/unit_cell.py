@@ -54,7 +54,17 @@ class UnitCell:
             types.append(AtomType.from_file(lbl, path))
             type_index[lbl] = len(types) - 1
         t_of_a, pos, mom = [], [], []
-        for lbl, plist in uc.atoms.items():
+        unknown = [l for l in uc.atoms if l not in type_index]
+        if unknown:
+            raise ValueError(
+                f"atom label(s) {unknown} in unit_cell.atoms have no entry "
+                "in unit_cell.atom_types / atom_files"
+            )
+        # reference atom enumeration follows the atom_types list order, not
+        # the "atoms" dict insertion order (forces/moments are reported per
+        # atom in that order)
+        for lbl in [l for l in uc.atom_types if l in uc.atoms]:
+            plist = uc.atoms[lbl]
             for p in plist:
                 p = list(p)
                 t_of_a.append(type_index[lbl])

@@ -165,6 +165,56 @@ def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
     return out / sym.num_ops
 
 
+def symmetrize_density_matrix(ctx: SimulationContext, dm: np.ndarray) -> np.ndarray:
+    """Symmetrize the beta-projector density matrix over the space group
+    (reference src/symmetry/symmetrize_density_matrix.hpp): the IBZ k-sum
+    only yields the full-BZ density matrix after averaging over operations,
+    dm'[S a] += D(S) dm[a] D(S)^T per atom block, with D block-diagonal over
+    the radial functions (real-harmonic Wigner blocks per l).
+
+    dm: [ns, nbeta_tot, nbeta_tot] complex; collinear spins transform
+    independently (no spin rotation without spin-orbit). Only the per-atom
+    diagonal blocks are symmetrized and returned — inter-atom blocks come
+    back zero (no consumer reads them; the reference stores the dm per atom
+    and has no inter-atom blocks at all)."""
+    from sirius_tpu.ops.hubbard import rlm_rotation_matrix
+
+    sym = ctx.symmetry
+    if sym is None or sym.num_ops <= 1:
+        return dm
+    uc = ctx.unit_cell
+    blocks = list(ctx.beta.atom_blocks(uc))
+    off_by_atom = {ia: off for ia, off, _ in blocks}
+    out = np.zeros_like(dm)
+    # per-(op, type) full-block rotation matrices, cached
+    for op in sym.ops:
+        dcache: dict = {}
+        rot_by_type: dict = {}
+        for ia, off, nbf in blocks:
+            it = uc.type_of_atom[ia]
+            if it not in rot_by_type:
+                t = uc.atom_types[it]
+                rmats = []
+                for b in t.beta:
+                    if b.l not in dcache:
+                        dcache[b.l] = rlm_rotation_matrix(op.rot_cart, b.l)
+                    rmats.append(dcache[b.l])
+                full = np.zeros((nbf, nbf))
+                pos = 0
+                for m in rmats:
+                    k = m.shape[0]
+                    full[pos : pos + k, pos : pos + k] = m
+                    pos += k
+                rot_by_type[it] = full
+            r = rot_by_type[it]
+            joff = off_by_atom[int(op.perm[ia])]
+            for ispn in range(dm.shape[0]):
+                out[ispn, joff : joff + nbf, joff : joff + nbf] += (
+                    r @ dm[ispn, off : off + nbf, off : off + nbf] @ r.T
+                )
+    return out / sym.num_ops
+
+
 def rho_real_space(ctx: SimulationContext, rho_g: np.ndarray) -> np.ndarray:
     """rho(r) on the fine box."""
     return np.asarray(

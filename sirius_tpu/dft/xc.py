@@ -84,23 +84,28 @@ _PBE_KAPPA = 0.804
 _PBE_MU = 0.2195149727645171
 _PBE_BETA = 0.06672455060314922
 _PBE_GAMMA = (1.0 - jnp.log(2.0)) / jnp.pi**2
+# PBEsol (Perdew et al. 2008): restore the gradient expansion for exchange
+_PBESOL_MU = 10.0 / 81.0
+_PBESOL_BETA = 0.046
 
 
-def _pbe_x_half(n2: jnp.ndarray, sigma4: jnp.ndarray) -> jnp.ndarray:
-    """PBE exchange per volume for a fully polarized channel (2n_sigma,
-    4 sigma_ss), halved by the caller's spin-scaling."""
+def _pbe_x_half(n2: jnp.ndarray, sigma4: jnp.ndarray, mu: float) -> jnp.ndarray:
+    """PBE-family exchange per volume for a fully polarized channel
+    (2n_sigma, 4 sigma_ss), halved by the caller's spin-scaling."""
     kf = (3.0 * jnp.pi**2 * n2) ** (1.0 / 3.0)
     ex_lda = -(3.0 / (4.0 * jnp.pi)) * kf * n2
     s2 = sigma4 / jnp.maximum(4.0 * kf**2 * n2**2, _TINY)
-    fx = 1.0 + _PBE_KAPPA - _PBE_KAPPA / (1.0 + _PBE_MU * s2 / _PBE_KAPPA)
+    fx = 1.0 + _PBE_KAPPA - _PBE_KAPPA / (1.0 + mu * s2 / _PBE_KAPPA)
     return ex_lda * fx
 
 
-def _pbe_x_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
-    return 0.5 * (_pbe_x_half(2 * nu, 4 * suu) + _pbe_x_half(2 * nd, 4 * sdd))
+def _pbe_x_e(nu, nd, suu, sud, sdd, mu: float = _PBE_MU) -> jnp.ndarray:
+    return 0.5 * (
+        _pbe_x_half(2 * nu, 4 * suu, mu) + _pbe_x_half(2 * nd, 4 * sdd, mu)
+    )
 
 
-def _pbe_c_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
+def _pbe_c_e(nu, nd, suu, sud, sdd, beta: float = _PBE_BETA) -> jnp.ndarray:
     n = nu + nd
     zeta = jnp.clip((nu - nd) / n, -1.0, 1.0)
     sigma = suu + 2 * sud + sdd
@@ -110,12 +115,20 @@ def _pbe_c_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
     ks = jnp.sqrt(4.0 * kf / jnp.pi)
     t2 = sigma / jnp.maximum((2.0 * phi * ks * n) ** 2, _TINY)
     a_den = jnp.exp(-eps_lda / (_PBE_GAMMA * phi**3)) - 1.0
-    aa = _PBE_BETA / _PBE_GAMMA / jnp.maximum(a_den, _TINY)
+    aa = beta / _PBE_GAMMA / jnp.maximum(a_den, _TINY)
     num = 1.0 + aa * t2
     h = _PBE_GAMMA * phi**3 * jnp.log1p(
-        _PBE_BETA / _PBE_GAMMA * t2 * num / (1.0 + aa * t2 + aa**2 * t2**2)
+        beta / _PBE_GAMMA * t2 * num / (1.0 + aa * t2 + aa**2 * t2**2)
     )
     return n * (eps_lda + h)
+
+
+def _pbesol_x_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
+    return _pbe_x_e(nu, nd, suu, sud, sdd, mu=_PBESOL_MU)
+
+
+def _pbesol_c_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
+    return _pbe_c_e(nu, nd, suu, sud, sdd, beta=_PBESOL_BETA)
 
 
 _LDA_FUNCS = {
@@ -126,6 +139,8 @@ _LDA_FUNCS = {
 _GGA_FUNCS = {
     "XC_GGA_X_PBE": _pbe_x_e,
     "XC_GGA_C_PBE": _pbe_c_e,
+    "XC_GGA_X_PBE_SOL": _pbesol_x_e,
+    "XC_GGA_C_PBE_SOL": _pbesol_c_e,
 }
 
 

@@ -25,6 +25,7 @@ def save_state(
     psi: np.ndarray | None = None,
     band_energies: np.ndarray | None = None,
     band_occupancies: np.ndarray | None = None,
+    paw_dm: np.ndarray | None = None,
 ) -> None:
     import h5py
 
@@ -37,6 +38,8 @@ def save_state(
         den.create_dataset("rho_g", data=np.asarray(rho_g))
         if mag_g is not None:
             den.create_dataset("mag_g", data=np.asarray(mag_g))
+        if paw_dm is not None:
+            den.create_dataset("paw_dm", data=np.asarray(paw_dm))
         if veff_g is not None:
             pot = f.create_group("potential")
             pot.create_dataset("veff_g", data=np.asarray(veff_g))
@@ -69,6 +72,8 @@ def load_state(path: str, ctx) -> dict:
         out["rho_g"] = f["density/rho_g"][...]
         if "mag_g" in f["density"]:
             out["mag_g"] = f["density/mag_g"][...]
+        if "paw_dm" in f["density"]:
+            out["paw_dm"] = f["density/paw_dm"][...]
         if "potential" in f:
             out["veff_g"] = f["potential/veff_g"][...]
             if "bz_g" in f["potential"]:

@@ -134,3 +134,32 @@ def test_pbe_c_vsigma_finite_difference():
     ep = float(xc.evaluate(rho, sig + h)["e"][0])
     em = float(xc.evaluate(rho, sig - h)["e"][0])
     np.testing.assert_allclose(float(out["vsigma"][0]), (ep - em) / (2 * h), rtol=1e-5)
+
+
+def test_pbesol_differs_from_pbe_only_in_gradient_terms():
+    xcs = XCFunctional(["XC_GGA_X_PBE_SOL", "XC_GGA_C_PBE_SOL"])
+    xcp = XCFunctional(["XC_GGA_X_PBE", "XC_GGA_C_PBE"])
+    rho = jnp.array([0.6])
+    # zero gradient: identical (same LDA limits)
+    np.testing.assert_allclose(
+        float(xcs.evaluate(rho, jnp.zeros(1))["e"][0]),
+        float(xcp.evaluate(rho, jnp.zeros(1))["e"][0]),
+        rtol=1e-12,
+    )
+    # finite gradient: PBEsol's weaker mu gives less negative exchange
+    sig = jnp.array([1.5])
+    es = float(XCFunctional(["XC_GGA_X_PBE_SOL"]).evaluate(rho, sig)["e"][0])
+    ep = float(XCFunctional(["XC_GGA_X_PBE"]).evaluate(rho, sig)["e"][0])
+    assert es > ep
+
+
+def test_pbesol_x_enhancement_factor():
+    # F_x(s=1) = 1 + kappa - kappa/(1 + mu_sol/kappa), mu_sol = 10/81
+    kappa, mu = 0.804, 10.0 / 81.0
+    rho = 1.0
+    kf = (3 * np.pi**2 * rho) ** (1 / 3)
+    sigma = (2 * kf * rho) ** 2
+    fx = float(
+        XCFunctional(["XC_GGA_X_PBE_SOL"]).evaluate(jnp.array([rho]), jnp.array([sigma]))["e"][0]
+    ) / float(XCFunctional(["XC_LDA_X"]).evaluate(jnp.array([rho]))["e"][0])
+    np.testing.assert_allclose(fx, 1 + kappa - kappa / (1 + mu / kappa), rtol=1e-8)
