@@ -60,6 +60,10 @@ class UnitCell:
                 f"atom label(s) {unknown} in unit_cell.atoms have no entry "
                 "in unit_cell.atom_types / atom_files"
             )
+        if len(set(uc.atom_types)) != len(uc.atom_types):
+            raise ValueError(
+                f"duplicate label(s) in unit_cell.atom_types: {uc.atom_types}"
+            )
         # reference atom enumeration follows the atom_types list order, not
         # the "atoms" dict insertion order (forces/moments are reported per
         # atom in that order)

@@ -34,11 +34,11 @@ Y00 = 1.0 / np.sqrt(4.0 * np.pi)
 
 
 def _cumulative_integral(r: np.ndarray, f: np.ndarray) -> np.ndarray:
-    """Cumulative spline integral int_0^{r_i} f dr (matches the reference's
-    Spline::integrate running sums)."""
+    """Cumulative spline integral int_{r_0}^{r_i} f dr (matches the
+    reference's Spline::integrate running sums; zero at the first knot)."""
     from scipy.interpolate import CubicSpline
 
-    return CubicSpline(r, f).antiderivative()(r) - CubicSpline(r, f).antiderivative()(r[0])
+    return CubicSpline(r, f).antiderivative()(r)
 
 
 @dataclasses.dataclass

@@ -580,18 +580,12 @@ def run_scf(
     if cfg.control.print_stress and num_iter_done > 0:
         from sirius_tpu.dft.stress import StressCalculator
 
-        if ctx.aug is not None:
-            import warnings
-
-            warnings.warn(
-                "ultrasoft augmentation stress response is not yet included; "
-                "stress is approximate for US species"
-            )
         calc = StressCalculator(ctx, xc)
         sterms = calc.compute(
             rho_g, mag_g, rho_r,
             rho_real_space(ctx, mag_g) if polarized else None,
             psi, occ_np, evals, d_by_spin,
+            dm_blocks_by_spin=dm_blocks_by_spin if ctx.aug is not None else None,
         )
         result["stress"] = sterms["total"].tolist()
     if save_to:

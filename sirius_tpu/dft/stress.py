@@ -14,10 +14,17 @@ Implementation: each term's frozen-coefficient energy functional is written
 exactly for a strained lattice and differentiated by central differences in
 the 6 independent strain components (O(h^2), h = 1e-5). The reference builds
 closed-form d/dq radial tables instead (radial_integrals<true>,
-beta_projectors_strain_deriv.hpp) — same derivative, different evaluation;
-the whole tensor is validated against full-SCF strained-lattice finite
-differences in tests/test_stress.py. Ultrasoft augmentation stress is not
-yet included (the D-operator's own strain response); NC-accurate.
+beta_projectors_strain_deriv.hpp, sigma_us in stress.cpp) — same
+derivative, different evaluation; the whole tensor is validated against
+full-SCF strained-lattice finite differences in tests/test_stress.py.
+
+Ultrasoft/PAW augmentation: at frozen density-matrix blocks the
+augmentation charge rho_aug(eps, G) is rebuilt from strained Q(G) tables
+inside the Hartree/local/XC functionals (the psi part of the density keeps
+the pure Omega0/Omega coefficient scaling), which is exactly the
+reference's sigma_us term distributed over those functionals. PAW on-site
+energies are atom-attached and strain-invariant at frozen dm, so no extra
+term appears.
 """
 
 from __future__ import annotations
@@ -67,6 +74,15 @@ class StressCalculator:
         from sirius_tpu.ops.beta import beta_radial_table
 
         self.beta_tab = [beta_radial_table(t, qmax_gk) for t in uc.atom_types]
+        if ctx.aug is not None:
+            from sirius_tpu.ops.augmentation import aug_radial_tables
+
+            self.aug_tabs = [
+                aug_radial_tables(t, qmax_fine) if t.augmentation else None
+                for t in uc.atom_types
+            ]
+        else:
+            self.aug_tabs = None
 
     # --- strained geometric tables -------------------------------------
     def _recip(self, eps):
@@ -83,6 +99,50 @@ class StressCalculator:
     def _omega(self, eps):
         return float(abs(np.linalg.det(_strained(self.ctx.unit_cell.lattice, eps))))
 
+    # --- strained augmentation charge ----------------------------------
+    def _rho_aug_eps(self, eps, dm_comp):
+        """rho_aug(eps, G) at frozen per-atom dm blocks for one density
+        component (charge: dm_up+dm_dn; magnetization: dm_up-dm_dn) — the
+        production rho_aug_g assembly against strained Q(G) tables."""
+        from sirius_tpu.ops.augmentation import q_pw_at, rho_aug_g
+
+        ctx = self.ctx
+        uc = ctx.unit_cell
+        gc = self._gcart(eps)
+        om = self._omega(eps)
+        q_by_type = [
+            None
+            if at is None
+            else q_pw_at(uc.atom_types[it], self.aug_tabs[it], gc, om)
+            for it, at in enumerate(ctx.aug.per_type)
+        ]
+        return rho_aug_g(uc, ctx.gvec, ctx.aug, dm_comp, q_pw_by_type=q_by_type)
+
+    def _density_eps(self, eps):
+        """(rho(eps, G), mag(eps, G)): frozen psi-part coefficients scale
+        with Omega0/Omega; the augmentation part is rebuilt from strained
+        Q(G) at frozen dm. Memoized per strain point (three functionals
+        consume the same densities)."""
+        key = eps.tobytes()
+        hit = self._density_eps_cache.get(key)
+        if hit is not None:
+            return hit
+        scale = self.ctx.unit_cell.omega / self._omega(eps)
+        rho = (self._rho_g_ref - self._rho_aug0) * scale + (
+            self._rho_aug_eps(eps, self._dm_charge)
+            if self._dm_charge is not None
+            else 0.0
+        )
+        mag = None
+        if self._mag_g_ref is not None:
+            mag = (self._mag_g_ref - self._mag_aug0) * scale + (
+                self._rho_aug_eps(eps, self._dm_mag)
+                if self._dm_mag is not None
+                else 0.0
+            )
+        self._density_eps_cache[key] = (rho, mag)
+        return rho, mag
+
     # --- frozen-coefficient energy functionals -------------------------
     def e_kinetic(self, eps, psi, occ_w):
         gk = self._gkcart(eps)
@@ -94,21 +154,21 @@ class StressCalculator:
                 e += float(dens @ ek)
         return e
 
-    def e_hartree(self, eps, rho_g):
+    def e_hartree(self, eps):
+        rho, _ = self._density_eps(eps)
         g2 = np.sum(self._gcart(eps) ** 2, axis=1)[1:]
-        om0 = self.ctx.unit_cell.omega
-        return 2.0 * np.pi * om0**2 / self._omega(eps) * float(
-            np.sum(np.abs(rho_g[1:]) ** 2 / g2)
+        return 2.0 * np.pi * self._omega(eps) * float(
+            np.sum(np.abs(rho[1:]) ** 2 / g2)
         )
 
-    def e_vloc(self, eps, rho_g):
+    def e_vloc(self, eps):
+        rho, _ = self._density_eps(eps)
         glen = np.sqrt(np.sum(self._gcart(eps) ** 2, axis=1))
-        om0 = self.ctx.unit_cell.omega
         acc = 0.0
         for it in range(len(self.ctx.unit_cell.atom_types)):
             ff = self.vloc_tab[it](glen)
-            acc += float(np.real(np.vdot(rho_g, ff * np.conj(self.sfact[it]))))
-        return 4.0 * np.pi * om0 / self._omega(eps) * acc
+            acc += float(np.real(np.vdot(rho, ff * np.conj(self.sfact[it]))))
+        return 4.0 * np.pi * acc
 
     def e_ewald(self, eps):
         uc = self.ctx.unit_cell
@@ -118,15 +178,15 @@ class StressCalculator:
             self._gcart(eps), self.ctx.gvec.millers, self.ctx.cfg.parameters.pw_cutoff,
         )
 
-    def e_xc(self, eps, rho_r0, mag_r0):
-        """E_xc[(rho_val*Om0/Om + rho_core(eps))] * Om/N; core rebuilt from
-        its strained form factors (one FFT per evaluation)."""
+    def e_xc(self, eps):
+        """E_xc[rho(eps) + rho_core(eps)]; valence density from
+        _density_eps (psi-part scaling + strained augmentation), core
+        rebuilt from its strained form factors."""
         import jax.numpy as jnp
 
         from sirius_tpu.core.fftgrid import g_to_r
 
         ctx = self.ctx
-        om0 = ctx.unit_cell.omega
         om = self._omega(eps)
         glen = np.sqrt(np.sum(self._gcart(eps) ** 2, axis=1))
         core_g = np.zeros(ctx.gvec.num_gvec, dtype=np.complex128)
@@ -140,9 +200,10 @@ class StressCalculator:
         def to_r(f_g):
             return np.asarray(g_to_r(jnp.asarray(f_g), fidx, dims)).real
 
+        rho_eps_g, mag_eps_g = self._density_eps(eps)
         core_r = to_r(core_g) if np.any(core_g) else 0.0
-        scale = om0 / om
-        n = rho_r0.size
+        rho_r = to_r(rho_eps_g)
+        n = rho_r.size
 
         def sigma_of(total_g):
             """|grad f|^2 on the strained lattice (i G_s f(G))."""
@@ -150,12 +211,10 @@ class StressCalculator:
             grads = [to_r(1j * gc[:, i] * total_g) for i in range(3)]
             return grads
 
-        if mag_r0 is None:
-            rho = np.maximum(rho_r0 * scale + core_r, 1e-25)
+        if mag_eps_g is None:
+            rho = np.maximum(rho_r + core_r, 1e-25)
             if self.xc.is_gga:
-                # strained gradient of (scaled valence + strained core)
-                tot_g = self._rho_g_ref * scale + core_g
-                g = sigma_of(tot_g)
+                g = sigma_of(rho_eps_g + core_g)
                 sig = g[0] ** 2 + g[1] ** 2 + g[2] ** 2
                 e = np.asarray(
                     self.xc.evaluate(jnp.asarray(rho.ravel()), jnp.asarray(sig.ravel()))["e"]
@@ -163,13 +222,12 @@ class StressCalculator:
             else:
                 e = np.asarray(self.xc.evaluate(jnp.asarray(rho.ravel()))["e"])
         else:
-            tot = np.maximum(rho_r0 * scale + core_r, 1e-25)
-            m = np.clip(mag_r0 * scale, -tot, tot)
+            mag_r = to_r(mag_eps_g)
+            tot = np.maximum(rho_r + core_r, 1e-25)
+            m = np.clip(mag_r, -tot, tot)
             if self.xc.is_gga:
-                up_g = 0.5 * (self._rho_g_ref * scale + core_g + self._mag_g_ref * scale)
-                dn_g = 0.5 * (self._rho_g_ref * scale + core_g - self._mag_g_ref * scale)
-                gu = sigma_of(up_g)
-                gd = sigma_of(dn_g)
+                gu = sigma_of(0.5 * (rho_eps_g + core_g + mag_eps_g))
+                gd = sigma_of(0.5 * (rho_eps_g + core_g - mag_eps_g))
                 suu = sum(x * x for x in gu)
                 sdd = sum(x * x for x in gd)
                 sud = sum(a * b for a, b in zip(gu, gd))
@@ -237,17 +295,40 @@ class StressCalculator:
         return e
 
     # --- assembly -------------------------------------------------------
-    def compute(self, rho_g, mag_g, rho_r, mag_r, psi, occ, evals, d_by_spin) -> dict:
+    def compute(
+        self, rho_g, mag_g, rho_r, mag_r, psi, occ, evals, d_by_spin,
+        dm_blocks_by_spin=None,
+    ) -> dict:
+        """dm_blocks_by_spin: per-spin list of per-atom density-matrix
+        blocks (required for the augmentation stress of US/PAW species)."""
         ctx = self.ctx
         self._rho_g_ref = rho_g
         self._mag_g_ref = mag_g
+        self._dm_charge = self._dm_mag = None
+        self._rho_aug0 = 0.0
+        self._mag_aug0 = 0.0
+        self._density_eps_cache = {}
+        if ctx.aug is not None and dm_blocks_by_spin:
+            ns_dm = len(dm_blocks_by_spin)
+            natoms = len(dm_blocks_by_spin[0])
+            self._dm_charge = [
+                sum(dm_blocks_by_spin[s][ia] for s in range(ns_dm))
+                for ia in range(natoms)
+            ]
+            self._rho_aug0 = self._rho_aug_eps(np.zeros((3, 3)), self._dm_charge)
+            if mag_g is not None and ns_dm == 2:
+                self._dm_mag = [
+                    dm_blocks_by_spin[0][ia] - dm_blocks_by_spin[1][ia]
+                    for ia in range(natoms)
+                ]
+                self._mag_aug0 = self._rho_aug_eps(np.zeros((3, 3)), self._dm_mag)
         occ_w = occ * ctx.gkvec.weights[:, None, None]
         terms = {
             "kin": lambda e: self.e_kinetic(e, psi, occ_w),
-            "har": lambda e: self.e_hartree(e, rho_g),
-            "vloc": lambda e: self.e_vloc(e, rho_g),
+            "har": lambda e: self.e_hartree(e),
+            "vloc": lambda e: self.e_vloc(e),
             "ewald": lambda e: self.e_ewald(e),
-            "xc": lambda e: self.e_xc(e, rho_r, mag_r),
+            "xc": lambda e: self.e_xc(e),
             "nonloc": lambda e: self.e_nonloc(e, psi, occ_w, evals, d_by_spin),
         }
         out = {}

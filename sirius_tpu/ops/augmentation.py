@@ -52,62 +52,38 @@ class Augmentation:
         return Augmentation(per_type=out)
 
 
-def _build_type(t, gvec: Gvec, omega: float) -> AugmentationType:
-    lb = t.lmax_beta
-    lmax3 = 2 * lb
-    nbf = t.num_beta_lm
-    idxrf, ls, ms = t.beta_lm_table()
+def aug_radial_tables(t, qmax: float) -> list:
+    """Per-l3 spline tables of RI_aug(packed rf12, l3, q), evaluable at
+    arbitrary q <= qmax (used for shells here; for strained |G| in the
+    stress calculator)."""
+    lmax3 = 2 * t.lmax_beta
     nbrf = t.num_beta
-
-    # radial integrals RI(packed rf12, l3, |G| shell)
     nrf12 = nbrf * (nbrf + 1) // 2
     qfuncs = np.zeros((nrf12, lmax3 + 1, len(t.r)))
     for ch in t.augmentation:
         i, j = min(ch.i, ch.j), max(ch.i, ch.j)
         idx = j * (j + 1) // 2 + i
         qfuncs[idx, ch.l, : len(ch.qr)] = ch.qr
-    qshell = np.sqrt(gvec.shell_g2)
-    ri = np.zeros((nrf12, lmax3 + 1, gvec.num_shells))
-    for l3 in range(lmax3 + 1):
-        tab = RadialIntegralTable.build(
-            t.r, qfuncs[:, l3, :], np.full(nrf12, l3), qmax=qshell[-1] + 1e-9, m=0
+    return [
+        RadialIntegralTable.build(
+            t.r, qfuncs[:, l3, :], np.full(nrf12, l3), qmax=qmax, m=0
         )
-        ri[:, l3, :] = tab(qshell)
+        for l3 in range(lmax3 + 1)
+    ]
 
-    # angular part
-    glen = np.sqrt(gvec.glen2)
-    rhat = np.where(
-        glen[:, None] > 1e-30, gvec.gcart / np.maximum(glen, 1e-30)[:, None], np.array([0.0, 0, 1.0])
-    )
-    rlm3 = ylm_real(lmax3, rhat)  # (ng, nlm3)
-    gaunt = gaunt_rlm(lb, lb, lmax3)  # (lm1, lm2, lm3)
-    mi_l3 = np.asarray([(-1j) ** l for l in range(lmax3 + 1)])
-    l_of_lm3 = np.asarray([int(np.sqrt(lm)) for lm in range(num_lm(lmax3))])
 
+def _build_type(t, gvec: Gvec, omega: float) -> AugmentationType:
+    nbf = t.num_beta_lm
+    qshell = np.sqrt(gvec.shell_g2)
+    tabs = aug_radial_tables(t, qmax=qshell[-1] + 1e-9)
+    q_pw = q_pw_at(t, tabs, gvec.gcart, omega)
     nqlm = nbf * (nbf + 1) // 2
-    q_pw = np.zeros((nqlm, gvec.num_gvec), dtype=np.complex128)
     xi1 = np.zeros(nqlm, dtype=np.int32)
     xi2 = np.zeros(nqlm, dtype=np.int32)
-    pref = 4.0 * np.pi / omega
     for b in range(nbf):
         for a in range(b + 1):
-            idx12 = b * (b + 1) // 2 + a
-            xi1[idx12], xi2[idx12] = a, b
-            ra, rb = int(idxrf[a]), int(idxrf[b])
-            rf12 = max(ra, rb) * (max(ra, rb) + 1) // 2 + min(ra, rb)
-            lm_a = lm_index(int(ls[a]), int(ms[a]))
-            lm_b = lm_index(int(ls[b]), int(ms[b]))
-            # sum over lm3 with nonzero Gaunt
-            acc = np.zeros(gvec.num_gvec, dtype=np.complex128)
-            for lm3 in np.nonzero(np.abs(gaunt[lm_a, lm_b]) > 1e-14)[0]:
-                l3 = l_of_lm3[lm3]
-                acc += (
-                    mi_l3[l3]
-                    * gaunt[lm_a, lm_b, lm3]
-                    * rlm3[:, lm3]
-                    * ri[rf12, l3, gvec.shell_idx]
-                )
-            q_pw[idx12] = pref * acc
+            xi1[b * (b + 1) // 2 + a] = a
+            xi2[b * (b + 1) // 2 + a] = b
     q0 = q_pw[:, 0].real * omega
     q_mtrx = np.zeros((nbf, nbf))
     q_mtrx[xi2, xi1] = q0
@@ -115,11 +91,57 @@ def _build_type(t, gvec: Gvec, omega: float) -> AugmentationType:
     return AugmentationType(q_pw=q_pw, xi1=xi1, xi2=xi2, q_mtrx=q_mtrx)
 
 
+def q_pw_at(t, tabs, gcart: np.ndarray, omega: float) -> np.ndarray:
+    """Q_{packed}(G) for arbitrary Cartesian G vectors (no atom phase):
+    the _build_type formula with the radial tables evaluated at |G| and the
+    real harmonics at ^G — the strained-lattice evaluation path of the
+    stress calculator (reference sigma_us uses d/dq tables instead,
+    stress.cpp)."""
+    lb = t.lmax_beta
+    lmax3 = 2 * lb
+    nbf = t.num_beta_lm
+    idxrf, ls, ms = t.beta_lm_table()
+    glen = np.linalg.norm(gcart, axis=1)
+    rhat = np.where(
+        glen[:, None] > 1e-30,
+        gcart / np.maximum(glen, 1e-30)[:, None],
+        np.array([0.0, 0, 1.0]),
+    )
+    rlm3 = ylm_real(lmax3, rhat)
+    gaunt = gaunt_rlm(lb, lb, lmax3)
+    mi_l3 = np.asarray([(-1j) ** l for l in range(lmax3 + 1)])
+    l_of_lm3 = np.asarray([int(np.sqrt(lm)) for lm in range(num_lm(lmax3))])
+    ri = np.stack([tabs[l3](glen) for l3 in range(lmax3 + 1)], axis=1)
+    nqlm = nbf * (nbf + 1) // 2
+    q_pw = np.zeros((nqlm, len(glen)), dtype=np.complex128)
+    pref = 4.0 * np.pi / omega
+    for b in range(nbf):
+        for a in range(b + 1):
+            idx12 = b * (b + 1) // 2 + a
+            ra, rb = int(idxrf[a]), int(idxrf[b])
+            rf12 = max(ra, rb) * (max(ra, rb) + 1) // 2 + min(ra, rb)
+            lm_a = lm_index(int(ls[a]), int(ms[a]))
+            lm_b = lm_index(int(ls[b]), int(ms[b]))
+            acc = np.zeros(len(glen), dtype=np.complex128)
+            for lm3 in np.nonzero(np.abs(gaunt[lm_a, lm_b]) > 1e-14)[0]:
+                l3 = l_of_lm3[lm3]
+                acc += (
+                    mi_l3[l3]
+                    * gaunt[lm_a, lm_b, lm3]
+                    * rlm3[:, lm3]
+                    * ri[rf12, l3, :]
+                )
+            q_pw[idx12] = pref * acc
+    return q_pw
+
+
 def rho_aug_g(
     uc: UnitCell,
     gvec: Gvec,
     aug: Augmentation,
     dm: list,  # per-atom (nbf_a, nbf_a) complex density-matrix blocks
+    q_pw_by_type: list | None = None,  # optional Q(G) override (e.g. the
+    # strained-lattice tables of the stress calculator)
 ) -> np.ndarray:
     """Augmentation charge rho_aug(G) on the fine set."""
     out = np.zeros(gvec.num_gvec, dtype=np.complex128)
@@ -127,7 +149,7 @@ def rho_aug_g(
         if at is None:
             continue
         atoms = uc.atoms_of_type(it)
-        nbf = uc.atom_types[it].num_beta_lm
+        q_pw = at.q_pw if q_pw_by_type is None else q_pw_by_type[it]
         # packed real dm with factor 2 off-diagonal:
         # sum_{xi1 xi2} n Q = sum_packed w * Re(n) * Q  (n hermitian, Q sym)
         w = np.where(at.xi1 == at.xi2, 1.0, 2.0)
@@ -136,7 +158,7 @@ def rho_aug_g(
         )  # (na_t, nqlm)
         phases = np.exp(-2j * np.pi * (gvec.millers @ uc.positions[atoms].T))  # (ng, na_t)
         # (ng, na_t) @ (na_t, nqlm) -> then contract with q_pw
-        out += np.einsum("ga,aq,qg->g", phases, dmp, at.q_pw, optimize=True)
+        out += np.einsum("ga,aq,qg->g", phases, dmp, q_pw, optimize=True)
     return out
 
 

@@ -57,3 +57,57 @@ def test_stress_matches_finite_difference():
         fm = _run(-eps)[0]["energy"]["free"]
         fd = (fp - fm) / (2 * h) / 2.0 / omega0  # symmetric-strain derivative
         np.testing.assert_allclose(sigma[a, b], fd, atol=4e-6, err_msg=f"{(a,b)}")
+
+
+def _run_us(strain=None):
+    import sirius_tpu.crystal.unit_cell as ucm
+
+    from sirius_tpu.dft.scf import run_scf
+
+    # gk_cutoff must sit INSIDE a G-shell gap (3.0001 < gk < 3.18): a shell
+    # at 3.000117 otherwise enters/leaves the basis under the FD strain and
+    # the 'ground truth' jumps discontinuously with basis size
+    ctx = synthetic_silicon_context(
+        gk_cutoff=3.09,
+        pw_cutoff=7.0,
+        ngridk=(1, 1, 1),
+        num_bands=8,
+        ultrasoft=True,
+        use_symmetry=False,
+        positions=np.array([[0.0, 0, 0], [0.26, 0.24, 0.25]]),
+        extra_params={"density_tol": 1e-10, "energy_tol": 1e-11, "num_dft_iter": 60},
+    )
+    if strain is not None:
+        uc = ctx.unit_cell
+        lat = uc.lattice @ (np.eye(3) + strain).T
+        uc2 = ucm.UnitCell(
+            lattice=lat, atom_types=uc.atom_types, type_of_atom=uc.type_of_atom,
+            positions=uc.positions, moments=uc.moments,
+        )
+        import sirius_tpu.context as cm
+
+        orig = ucm.UnitCell.from_config
+        try:
+            ucm.UnitCell.from_config = staticmethod(lambda c, b=".": uc2)
+            ctx = cm.SimulationContext.create(ctx.cfg, ".")
+        finally:
+            ucm.UnitCell.from_config = orig
+    ctx.cfg.control.print_stress = strain is None
+    return run_scf(ctx.cfg, ctx=ctx), ctx.unit_cell.omega
+
+
+def test_stress_ultrasoft_matches_finite_difference():
+    """US augmentation stress (the strained-Q response) against full-SCF
+    strained-lattice finite differences — the term round 1 omitted."""
+    res, omega0 = _run_us()
+    assert res["converged"]
+    sigma = np.asarray(res["stress"])
+    h = 1e-4
+    for (a, b) in [(0, 0), (0, 1)]:
+        eps = np.zeros((3, 3))
+        eps[a, b] += h
+        eps[b, a] += h
+        fp = _run_us(eps)[0]["energy"]["free"]
+        fm = _run_us(-eps)[0]["energy"]["free"]
+        fd = (fp - fm) / (2 * h) / 2.0 / omega0
+        np.testing.assert_allclose(sigma[a, b], fd, atol=4e-6, err_msg=f"{(a,b)}")
