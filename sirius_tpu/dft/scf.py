@@ -68,7 +68,9 @@ def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
         base *= ctx.gkvec.mask[ik]
         for ispn in range(ctx.num_spins):
             psi[ik, ispn] = base
-    return jnp.asarray(psi)
+    # host numpy, NOT a device array: complex must never be device-resident
+    # outside jit (parallel/batched.py real-boundary contract)
+    return psi
 
 
 def run_scf(
@@ -171,9 +173,7 @@ def run_scf(
         if prev_psi is not None and prev_psi.shape == (
             nk, ns, nb, ctx.gkvec.ngk_max,
         ):
-            psi = jnp.asarray(prev_psi) * jnp.asarray(
-                ctx.gkvec.mask[:, None, None, :]
-            )
+            psi = np.asarray(prev_psi) * ctx.gkvec.mask[:, None, None, :]
     # first PAW on-site update (from the file-occupation guess or the
     # restored/warm-started dm)
     paw_res = paw_mod.compute_paw(paw, paw_dm, xc) if paw is not None else None
@@ -195,11 +195,15 @@ def run_scf(
     # constant device tables, uploaded once (not per iteration); the full-
     # precision projector stack feeds the density-matrix accumulation
     # independently of the wave-function working dtype
-    beta_dev = (
-        jnp.asarray(np.asarray(ctx.beta.beta_gk))
-        if ctx.beta.num_beta_total
-        else None
-    )
+    # stored as a (re, im) real pair: complex arrays must never be device-
+    # resident outside jit (real-boundary contract, parallel/batched.py)
+    if ctx.beta.num_beta_total:
+        from sirius_tpu.parallel.batched import split_cplx as _sc
+
+        _bre, _bim = _sc(np.asarray(ctx.beta.beta_gk))
+        beta_dev = (jnp.asarray(_bre), jnp.asarray(_bim))
+    else:
+        beta_dev = None
     hub_phi_stack = (
         None if hub is None else np.stack([hub.phi_s_gk[ik] for ik in range(nk)])
     )
@@ -226,14 +230,18 @@ def run_scf(
                 hub_phi=hub_phi_stack, vhub=vhub_s,
             )
             return _kset_cache[dtype]
+        from sirius_tpu.parallel.batched import split_cplx
+
         h_diag = compute_h_diag(ctx, np.asarray(d_stack), v0)
+        vh = (None, None) if vhub_s is None else split_cplx(vhub_s, rdt)
         # store the refreshed params back so the previous iteration's
         # potential-dependent device buffers are released
         _kset_cache[dtype] = _kset_cache[dtype]._replace(
             veff_r=jnp.asarray(veff_stack, dtype=rdt),
             dion=jnp.asarray(d_stack, dtype=rdt),
             h_diag=jnp.asarray(h_diag, dtype=rdt),
-            vhub=None if vhub_s is None else jnp.asarray(vhub_s, dtype=dtype),
+            vhub_re=None if vh[0] is None else jnp.asarray(vh[0]),
+            vhub_im=None if vh[1] is None else jnp.asarray(vh[1]),
         )
         return _kset_cache[dtype]
 
@@ -288,6 +296,7 @@ def run_scf(
     x_mix = pack(rho_g, mag_g, om_mixed, paw_dm)
 
     evals = np.zeros((nk, ns, nb))
+    pr = pi = None  # batched-path device-resident (re, im) wave functions
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
     etot_history, rms_history = [], []
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
@@ -340,18 +349,36 @@ def run_scf(
                 psi = jnp.stack(new_psi)
             else:
                 # production path: the whole (k, spin) set as ONE program
-                # (parallel/batched.py; shards over the ("k", "b") mesh)
-                from sirius_tpu.parallel.batched import davidson_kset
+                # (parallel/batched.py; shards over the ("k", "b") mesh).
+                # Real-boundary: psi crosses the jit boundary as a (re, im)
+                # pair — the TPU backend cannot transfer complex arrays.
+                from sirius_tpu.ops.hamiltonian import real_dtype_of
+                from sirius_tpu.parallel.batched import (
+                    davidson_kset,
+                    join_cplx,
+                    split_cplx,
+                )
 
                 ps = kset_params(
                     pot.veff_r_coarse[:ns], np.stack(d_by_spin), v0, vhub,
                     wf_dtype,
                 )
-                ev, psi, rn = davidson_kset(
-                    ps, psi.astype(wf_dtype),
+                rdt = real_dtype_of(wf_dtype)
+                if pr is None or pr.dtype != np.dtype(rdt):
+                    # initial entry or precision switch; psi may be stale
+                    # (None) if the previous iterations kept the pair only
+                    src = psi if psi is not None else join_cplx(pr, pi)
+                    pr, pi = split_cplx(np.asarray(src), rdt)
+                ev, pr, pi, rn = davidson_kset(
+                    ps, pr, pi,
                     num_steps=itsol.num_steps,
                     res_tol=itsol.residual_tolerance,
                 )
+                # psi stays device-resident as the (pr, pi) pair between
+                # iterations; the complex host copy is materialized only for
+                # consumers that need it (Hubbard occupations each
+                # iteration, forces/stress/checkpoint after the loop)
+                psi = join_cplx(pr, pi) if hub is not None else None
                 evals = np.asarray(ev, dtype=np.float64)
             # H*psi application count (reference num_loc_op_applied counter)
             from sirius_tpu.solvers.davidson import num_applies
@@ -393,14 +420,21 @@ def run_scf(
                 from sirius_tpu.parallel.batched import density_kset
 
                 rho_spin = density_from_coarse_acc(
-                    ctx, np.asarray(density_kset(ps, psi, occ_w))
+                    ctx, np.asarray(density_kset(ps, pr, pi, occ_w))
                 )
         dm_blocks_by_spin = []
         if ctx.aug is not None:
             from sirius_tpu.dft.density import symmetrize_density_matrix
-            from sirius_tpu.parallel.batched import density_matrix_kset
+            from sirius_tpu.parallel.batched import density_matrix_kset, split_cplx
 
-            dm_by_spin = np.asarray(density_matrix_kset(beta_dev, psi, occ_w))
+            if pr is not None:
+                ppair = (pr, pi)  # batched path: already device-resident
+            else:
+                ppair = split_cplx(np.asarray(psi))
+            dm_re, dm_im = density_matrix_kset(*beta_dev, *ppair, occ_w)
+            from sirius_tpu.parallel.batched import join_cplx as _jc
+
+            dm_by_spin = _jc(dm_re, dm_im)
             if do_symmetrize:
                 dm_by_spin = symmetrize_density_matrix(ctx, dm_by_spin)
             for ispn in range(ns):
@@ -501,6 +535,10 @@ def run_scf(
             break
 
     # --- final report ---
+    if psi is None:
+        from sirius_tpu.parallel.batched import join_cplx
+
+        psi = join_cplx(pr, pi)
     occ_np = np.asarray(occ)
     band_gap = _band_gap(evals, occ_np, ctx)
     rho_r = rho_real_space(ctx, rho_g)

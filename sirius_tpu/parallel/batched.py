@@ -7,6 +7,14 @@ make the entire k-set one vmapped davidson call — a single XLA program that
 shards over the mesh with zero hand-written collectives (density reduction
 over "k" is a psum XLA inserts from the einsum).
 
+REAL-BOUNDARY CONTRACT: the TPU backend in this environment cannot move
+complex arrays across any host<->device or jit boundary (transfers and
+executable I/O with complex dtypes fail with UNIMPLEMENTED and wedge the
+process; measured empirically — see bench.py). Every jitted entry point
+here therefore takes and returns REAL arrays only; complex leaves of the
+parameter pytree are stored as (re, im) pairs and the complex working
+arrays exist only inside the compiled programs.
+
 This is the PRODUCTION band-solve path: dft/scf.run_scf drives it each SCF
 iteration with the per-spin screened D matrices and Hubbard potentials
 batched in (a serial per-(k, spin) fallback remains for debugging).
@@ -26,23 +34,48 @@ from sirius_tpu.solvers.davidson import davidson
 
 
 class HkSetParams(NamedTuple):
-    """Batched-over-(k, spin) Hamiltonian data.
+    """Batched-over-(k, spin) Hamiltonian data, real leaves only.
 
     Per-k leaves carry a leading nk axis; spin-dependent leaves (potential,
     screened D, Hubbard V) carry an ns axis. ns == num_spins of the run
-    (1 for unpolarized, 2 collinear)."""
+    (1 for unpolarized, 2 collinear). Complex tables are split into re/im
+    real arrays (see module docstring)."""
 
     veff_r: jax.Array  # [ns, n1,n2,n3] effective potential per spin channel
     ekin: jax.Array  # [nk, ngk]
     mask: jax.Array  # [nk, ngk]
     fft_index: jax.Array  # [nk, ngk]
-    beta: jax.Array  # [nk, nbeta, ngk]
+    beta_re: jax.Array  # [nk, nbeta, ngk]
+    beta_im: jax.Array  # [nk, nbeta, ngk]
     dion: jax.Array  # [ns, nbeta, nbeta] screened D per spin
     qmat: jax.Array  # [nbeta, nbeta] shared
     h_diag: jax.Array  # [nk, ns, ngk]
     o_diag: jax.Array  # [nk, ngk] (S is spin-independent)
-    hub: jax.Array = None  # [nk, nhub, ngk] S-weighted Hubbard orbitals
-    vhub: jax.Array = None  # [ns, nhub, nhub]
+    hub_re: jax.Array = None  # [nk, nhub, ngk] S-weighted Hubbard orbitals
+    hub_im: jax.Array = None
+    vhub_re: jax.Array = None  # [ns, nhub, nhub]
+    vhub_im: jax.Array = None
+
+
+def _cplx(re, im):
+    """Complex from a re/im pair — ONLY call inside a jitted program."""
+    return jax.lax.complex(re, im)
+
+
+def split_cplx(a, rdtype=None):
+    """Host-side split of a numpy complex array into a (re, im) real pair."""
+    a = np.asarray(a)
+    re = np.ascontiguousarray(np.real(a))
+    im = np.ascontiguousarray(np.imag(a))
+    if rdtype is not None:
+        re = re.astype(rdtype)
+        im = im.astype(rdtype)
+    return re, im
+
+
+def join_cplx(re, im):
+    """Host-side join of a (re, im) device/real pair into numpy complex."""
+    return np.asarray(re).astype(np.complex128) + 1j * np.asarray(im)
 
 
 def compute_h_diag(ctx, dion, v0: float = 0.0):
@@ -82,19 +115,39 @@ def compute_o_diag(ctx):
     return o_diag
 
 
-def hkset_slice(params: HkSetParams, ik: int = 0, ispn: int = 0) -> HkParams:
-    """Single-(k, spin) HkParams view of a batched HkSetParams (used by the
-    bench/probe/entry micro-workloads; Hubbard leaves carried along)."""
-    return HkParams(
+def hkset_slice_r(params: HkSetParams, ik: int = 0, ispn: int = 0):
+    """Single-(k, spin) real-leaf view of a batched HkSetParams, as a dict
+    suitable for jit closure constants or real-boundary jit args. Rebuild
+    the complex HkParams INSIDE the jitted program with hk_complex()."""
+    return dict(
         veff_r=params.veff_r[ispn],
         ekin=params.ekin[ik],
         mask=params.mask[ik],
         fft_index=params.fft_index[ik],
-        beta=params.beta[ik],
+        beta_re=params.beta_re[ik],
+        beta_im=params.beta_im[ik],
         dion=params.dion[ispn],
         qmat=params.qmat,
-        hub=None if params.hub is None else params.hub[ik],
-        vhub=None if params.vhub is None else params.vhub[ispn],
+        hub_re=None if params.hub_re is None else params.hub_re[ik],
+        hub_im=None if params.hub_im is None else params.hub_im[ik],
+        vhub_re=None if params.vhub_re is None else params.vhub_re[ispn],
+        vhub_im=None if params.vhub_im is None else params.vhub_im[ispn],
+    )
+
+
+def hk_complex(p: dict) -> HkParams:
+    """Assemble the complex per-k HkParams from real leaves; call only
+    inside jit (complex must never cross the program boundary)."""
+    return HkParams(
+        veff_r=p["veff_r"],
+        ekin=p["ekin"],
+        mask=p["mask"],
+        fft_index=p["fft_index"],
+        beta=_cplx(p["beta_re"], p["beta_im"]),
+        dion=p["dion"],
+        qmat=p["qmat"],
+        hub=None if p["hub_re"] is None else _cplx(p["hub_re"], p["hub_im"]),
+        vhub=None if p["vhub_re"] is None else _cplx(p["vhub_re"], p["vhub_im"]),
     )
 
 
@@ -110,7 +163,7 @@ def make_hkset_params(
     """veff_r_coarse: [n1,n2,n3] or [ns, n1,n2,n3]; d_full: [nbeta,nbeta] or
     [ns,nbeta,nbeta] screened D (defaults to the bare dion); v0: average
     effective potential veff(G=0), included in the preconditioner diagonal
-    exactly like the serial path (_h_o_diag)."""
+    exactly like the serial path (_h_o_diag). All leaves are REAL arrays."""
     from sirius_tpu.ops.hamiltonian import real_dtype_of
 
     nbeta = ctx.beta.num_beta_total
@@ -129,73 +182,89 @@ def make_hkset_params(
     h_diag = compute_h_diag(ctx, dion, v0)
     o_diag = compute_o_diag(ctx)
     beta = (
-        ctx.beta.beta_gk
+        np.asarray(ctx.beta.beta_gk)
         if nbeta
         else np.zeros((nk, 0, ctx.gkvec.ngk_max), dtype=np.complex128)
     )
+    beta_re, beta_im = split_cplx(beta, rdtype)
+    hub_pair = (None, None) if hub_phi is None else split_cplx(hub_phi, rdtype)
+    vhub_pair = (None, None) if vhub is None else split_cplx(vhub, rdtype)
+    asr = lambda a: jnp.asarray(a, dtype=rdtype)
     return HkSetParams(
-        veff_r=jnp.asarray(veff, dtype=rdtype),
-        ekin=jnp.asarray(ekin, dtype=rdtype),
-        mask=jnp.asarray(ctx.gkvec.mask, dtype=rdtype),
+        veff_r=asr(veff),
+        ekin=asr(ekin),
+        mask=asr(ctx.gkvec.mask),
         fft_index=jnp.asarray(ctx.gkvec.fft_index),
-        beta=jnp.asarray(beta, dtype=dtype),
-        dion=jnp.asarray(dion, dtype=rdtype),
-        qmat=jnp.asarray(qmat, dtype=rdtype),
-        h_diag=jnp.asarray(h_diag, dtype=rdtype),
-        o_diag=jnp.asarray(o_diag, dtype=rdtype),
-        hub=None if hub_phi is None else jnp.asarray(hub_phi, dtype=dtype),
-        vhub=None if vhub is None else jnp.asarray(vhub, dtype=dtype),
-    )
-
-
-def _davidson_one_k(params_k: HkParams, h_diag, o_diag, x0, num_steps, res_tol):
-    return davidson(
-        apply_h_s, params_k, x0, h_diag, o_diag, params_k.mask,
-        num_steps=num_steps, res_tol=res_tol,
+        beta_re=jnp.asarray(beta_re),
+        beta_im=jnp.asarray(beta_im),
+        dion=asr(dion),
+        qmat=asr(qmat),
+        h_diag=asr(h_diag),
+        o_diag=asr(o_diag),
+        hub_re=None if hub_pair[0] is None else jnp.asarray(hub_pair[0]),
+        hub_im=None if hub_pair[1] is None else jnp.asarray(hub_pair[1]),
+        vhub_re=None if vhub_pair[0] is None else jnp.asarray(vhub_pair[0]),
+        vhub_im=None if vhub_pair[1] is None else jnp.asarray(vhub_pair[1]),
     )
 
 
 @partial(jax.jit, static_argnames=("num_steps",))
-def davidson_kset(params: HkSetParams, psi, num_steps: int = 20, res_tol: float = 1e-6):
+def davidson_kset(
+    params: HkSetParams, psi_re, psi_im, num_steps: int = 20, res_tol: float = 1e-6
+):
     """Solve bands at every (k, spin) in one vmapped call.
 
-    psi: [nk, ns, nb, ngk] -> (evals [nk, ns, nb], psi', rnorm [nk, ns, nb]).
-    """
+    psi_re/psi_im: [nk, ns, nb, ngk] real pair ->
+    (evals [nk, ns, nb], psi_re', psi_im', rnorm [nk, ns, nb])."""
+    psi = _cplx(psi_re, psi_im)
+    has_hub = params.hub_re is not None
 
-    def one_k(ekin, mask, fft_index, beta, h_diag_k, o_diag, hub_k, psi_k):
-        def one_spin(veff_s, dion_s, vhub_s, h_diag_s, x0):
+    def one_k(ekin, mask, fft_index, beta_re, beta_im, h_diag_k, o_diag,
+              hub_re_k, hub_im_k, psi_k):
+        def one_spin(veff_s, dion_s, vhub_re_s, vhub_im_s, h_diag_s, x0):
             pk = HkParams(
                 veff_r=veff_s,
                 ekin=ekin,
                 mask=mask,
                 fft_index=fft_index,
-                beta=beta,
+                beta=_cplx(beta_re, beta_im),
                 dion=dion_s,
                 qmat=params.qmat,
-                hub=hub_k,
-                vhub=vhub_s,
+                hub=None if hub_re_k is None else _cplx(hub_re_k, hub_im_k),
+                vhub=None if vhub_re_s is None else _cplx(vhub_re_s, vhub_im_s),
             )
-            return _davidson_one_k(pk, h_diag_s, o_diag, x0, num_steps, res_tol)
+            return davidson(
+                apply_h_s, pk, x0, h_diag_s, o_diag, mask,
+                num_steps=num_steps, res_tol=res_tol,
+            )
 
-        return jax.vmap(one_spin)(
-            params.veff_r, params.dion, params.vhub, h_diag_k, psi_k
-        )
+        return jax.vmap(
+            one_spin,
+            in_axes=(0, 0, None if not has_hub else 0,
+                     None if not has_hub else 0, 0, 0),
+        )(params.veff_r, params.dion, params.vhub_re, params.vhub_im,
+          h_diag_k, psi_k)
 
-    return jax.vmap(
+    hub_ax = 0 if has_hub else None
+    ev, x, rn = jax.vmap(
         one_k,
-        in_axes=(0, 0, 0, 0, 0, 0, None if params.hub is None else 0, 0),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, hub_ax, hub_ax, 0),
     )(
-        params.ekin, params.mask, params.fft_index, params.beta,
-        params.h_diag, params.o_diag, params.hub, psi,
+        params.ekin, params.mask, params.fft_index, params.beta_re,
+        params.beta_im, params.h_diag, params.o_diag,
+        params.hub_re, params.hub_im, psi,
     )
+    return ev, jnp.real(x), jnp.imag(x), rn
 
 
 @jax.jit
-def density_kset(params: HkSetParams, psi, occ_w):
+def density_kset(params: HkSetParams, psi_re, psi_im, occ_w):
     """Coarse-box density sum_{k,b} occ_w |psi(r)|^2 per spin — contracts
     over the whole k-set in one program (psum over "k" under sharding).
 
-    occ_w: [nk, ns, nb] occupation x k-weight. Returns [ns, n1, n2, n3]."""
+    occ_w: [nk, ns, nb] occupation x k-weight. Returns [ns, n1, n2, n3]
+    (real)."""
+    psi = _cplx(psi_re, psi_im)
     dims = params.veff_r.shape[-3:]
     n = dims[0] * dims[1] * dims[2]
 
@@ -209,17 +278,22 @@ def density_kset(params: HkSetParams, psi, occ_w):
 
 
 @jax.jit
-def density_matrix_kset(beta, psi, occ_w):
+def density_matrix_kset(beta_re, beta_im, psi_re, psi_im, occ_w):
     """Non-local density matrix n^sigma_{xi xi'} = sum_{k,b} occ_w
     conj(<beta_xi|psi>) <beta_xi'|psi>, contracted over the whole k-set
     (reference add_k_point_contribution_dm_pwpp, density.cpp:847-901).
 
-    beta: [nk, nbeta, ngk] projector tables (pass the full-precision c128
-    stack so the accumulation precision is independent of the wave-function
-    working dtype). Returns [ns, nbeta, nbeta]."""
+    beta_re/beta_im: [nk, nbeta, ngk] projector tables (pass the
+    full-precision f64 pair so the accumulation precision is independent of
+    the wave-function working dtype). Returns a (re, im) pair of
+    [ns, nbeta, nbeta]."""
+    rdt = jnp.promote_types(beta_re.dtype, psi_re.dtype)
+    beta = _cplx(beta_re.astype(rdt), beta_im.astype(rdt))
+    psi = _cplx(psi_re.astype(rdt), psi_im.astype(rdt))
 
     def one_k(beta_k, psi_k, ow):
         bp = jnp.einsum("xg,sbg->sbx", jnp.conj(beta_k), psi_k)
         return jnp.einsum("sb,sbx,sby->sxy", ow, jnp.conj(bp), bp)
 
-    return jnp.sum(jax.vmap(one_k)(beta, psi, occ_w), axis=0)
+    dm = jnp.sum(jax.vmap(one_k)(beta, psi, occ_w), axis=0)
+    return jnp.real(dm), jnp.imag(dm)

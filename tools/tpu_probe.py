@@ -39,7 +39,11 @@ def main(stage: int) -> None:
         y = f(jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32))
         jax.block_until_ready(y)
     elif stage == 2:
-        from sirius_tpu.parallel.batched import hkset_slice, make_hkset_params
+        from sirius_tpu.parallel.batched import (
+            hk_complex,
+            hkset_slice_r,
+            make_hkset_params,
+        )
         from sirius_tpu.ops.hamiltonian import apply_h_s
         from sirius_tpu.testing import synthetic_silicon_context
 
@@ -48,10 +52,11 @@ def main(stage: int) -> None:
             use_symmetry=False,
         )
         ps = make_hkset_params(ctx, np.full(ctx.fft_coarse.dims, 0.05), dtype=jnp.complex64)
-        pk = hkset_slice(ps)
+        slc = hkset_slice_r(ps)
 
         @jax.jit
         def f(pr, pi):
+            pk = hk_complex(slc)
             h, s = apply_h_s(pk, (pr + 1j * pi).astype(jnp.complex64))
             return jnp.real(h), jnp.imag(h)
 
@@ -88,7 +93,9 @@ def main(stage: int) -> None:
         ).astype(np.complex64) * ctx.gkvec.mask[:, None, None, :].astype(np.float32)
         nsteps = 1 if stage == 4 else 20
 
-        ev, x, rn = davidson_kset(ps, jnp.asarray(psi), num_steps=nsteps)
+        pr = jnp.asarray(np.real(psi), jnp.float32)
+        pi = jnp.asarray(np.imag(psi), jnp.float32)
+        ev, pr2, pi2, rn = davidson_kset(ps, pr, pi, num_steps=nsteps)
         jax.block_until_ready((ev, rn))
         print(f"[{time.time()-t0:6.1f}s] evals[:4]={np.asarray(ev)[0,0,:4]}", flush=True)
     print(f"[{time.time()-t0:6.1f}s] stage {stage} OK", flush=True)
