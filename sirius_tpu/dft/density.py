@@ -107,8 +107,8 @@ def atomic_sphere_radii(uc, rmax: float = 2.0) -> np.ndarray:
     return np.minimum(0.5 * d.min(axis=(1, 2)), rmax)
 
 
-def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
-    """Initial z-magnetization from per-atom starting moments.
+def initial_magnetization_vec_g(ctx: SimulationContext) -> np.ndarray:
+    """[3, ng] initial (mx, my, mz) from per-atom starting moment vectors.
 
     Each atom contributes its full moment in a compact normalized bump
     w(R, x) = (1 - (x/R)^2) e^{x/R} / (3.18866 R^3) inside an atomic sphere
@@ -119,14 +119,14 @@ def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
 
     uc = ctx.unit_cell
     gv = ctx.gvec
-    out = np.zeros(gv.num_gvec, dtype=np.complex128)
-    if not np.any(np.abs(uc.moments[:, 2]) > 1e-12):
+    out = np.zeros((3, gv.num_gvec), dtype=np.complex128)
+    if not np.any(np.abs(uc.moments) > 1e-12):
         return out
     rad = atomic_sphere_radii(uc)
     qshell = np.sqrt(gv.shell_g2)
     for ia in range(uc.num_atoms):
-        mz = uc.moments[ia, 2]
-        if abs(mz) < 1e-12:
+        mvec = uc.moments[ia]
+        if np.all(np.abs(mvec) < 1e-12):
             continue
         r = np.linspace(1e-8, rad[ia], 400)
         w = (1 - (r / rad[ia]) ** 2) * np.exp(r / rad[ia]) / (
@@ -134,8 +134,15 @@ def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
         )
         ff = sbessel_integral(r, 4.0 * np.pi * w, 0, qshell, m=2)[gv.shell_idx]
         phase = np.exp(-2j * np.pi * (gv.millers @ uc.positions[ia]))
-        out += (mz / uc.omega) * ff * phase
+        for i in range(3):
+            if abs(mvec[i]) > 1e-12:
+                out[i] += (mvec[i] / uc.omega) * ff * phase
     return out
+
+
+def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
+    """Initial z-magnetization (collinear): z-component of the vector seed."""
+    return initial_magnetization_vec_g(ctx)[2]
 
 
 def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
@@ -165,6 +172,34 @@ def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
     return out / sym.num_ops
 
 
+def _beta_rotation_blocks(ctx: SimulationContext, op):
+    """Per-atom-type block-diagonal Rlm rotation matrices for one symmetry
+    op (shared by the collinear and non-collinear dm symmetrizers)."""
+    from sirius_tpu.ops.hubbard import rlm_rotation_matrix
+
+    uc = ctx.unit_cell
+    dcache: dict = {}
+    rot_by_type: dict = {}
+    for ia, off, nbf in ctx.beta.atom_blocks(uc):
+        it = uc.type_of_atom[ia]
+        if it in rot_by_type:
+            continue
+        t = uc.atom_types[it]
+        rmats = []
+        for b in t.beta:
+            if b.l not in dcache:
+                dcache[b.l] = rlm_rotation_matrix(op.rot_cart, b.l)
+            rmats.append(dcache[b.l])
+        full = np.zeros((nbf, nbf))
+        pos = 0
+        for m in rmats:
+            k = m.shape[0]
+            full[pos : pos + k, pos : pos + k] = m
+            pos += k
+        rot_by_type[it] = full
+    return rot_by_type
+
+
 def symmetrize_density_matrix(ctx: SimulationContext, dm: np.ndarray) -> np.ndarray:
     """Symmetrize the beta-projector density matrix over the space group
     (reference src/symmetry/symmetrize_density_matrix.hpp): the IBZ k-sum
@@ -177,8 +212,6 @@ def symmetrize_density_matrix(ctx: SimulationContext, dm: np.ndarray) -> np.ndar
     diagonal blocks are symmetrized and returned — inter-atom blocks come
     back zero (no consumer reads them; the reference stores the dm per atom
     and has no inter-atom blocks at all)."""
-    from sirius_tpu.ops.hubbard import rlm_rotation_matrix
-
     sym = ctx.symmetry
     if sym is None or sym.num_ops <= 1:
         return dm
@@ -186,32 +219,50 @@ def symmetrize_density_matrix(ctx: SimulationContext, dm: np.ndarray) -> np.ndar
     blocks = list(ctx.beta.atom_blocks(uc))
     off_by_atom = {ia: off for ia, off, _ in blocks}
     out = np.zeros_like(dm)
-    # per-(op, type) full-block rotation matrices, cached
     for op in sym.ops:
-        dcache: dict = {}
-        rot_by_type: dict = {}
+        rot_by_type = _beta_rotation_blocks(ctx, op)
         for ia, off, nbf in blocks:
-            it = uc.type_of_atom[ia]
-            if it not in rot_by_type:
-                t = uc.atom_types[it]
-                rmats = []
-                for b in t.beta:
-                    if b.l not in dcache:
-                        dcache[b.l] = rlm_rotation_matrix(op.rot_cart, b.l)
-                    rmats.append(dcache[b.l])
-                full = np.zeros((nbf, nbf))
-                pos = 0
-                for m in rmats:
-                    k = m.shape[0]
-                    full[pos : pos + k, pos : pos + k] = m
-                    pos += k
-                rot_by_type[it] = full
-            r = rot_by_type[it]
+            r = rot_by_type[uc.type_of_atom[ia]]
             joff = off_by_atom[int(op.perm[ia])]
             for ispn in range(dm.shape[0]):
                 out[ispn, joff : joff + nbf, joff : joff + nbf] += (
                     r @ dm[ispn, off : off + nbf, off : off + nbf] @ r.T
                 )
+    return out / sym.num_ops
+
+
+def symmetrize_density_matrix_nc(ctx: SimulationContext, dm3: np.ndarray) -> np.ndarray:
+    """Non-collinear density-matrix symmetrization.
+
+    dm3: [3, nbeta, nbeta] complex spin components (uu, dd, ud) — the du
+    block is the Hermitian conjugate. Decompose per atom into the scalar
+    d0 = uu + dd and the AXIAL vector (dx, dy, dz) = (ud + ud^H,
+    i(ud - ud^H), uu - dd); the scalar transforms with the Wigner blocks
+    alone, the vector additionally rotates with det(R) R (reference
+    symmetrize_density_matrix.hpp spin_rotation branch)."""
+    sym = ctx.symmetry
+    if sym is None or sym.num_ops <= 1:
+        return dm3
+    uc = ctx.unit_cell
+    blocks = list(ctx.beta.atom_blocks(uc))
+    off_by_atom = {ia: off for ia, off, _ in blocks}
+    out = np.zeros_like(dm3)
+    for op in sym.ops:
+        rot_by_type = _beta_rotation_blocks(ctx, op)
+        srot = np.linalg.det(op.rot_cart) * op.rot_cart  # axial-vector rotation
+        for ia, off, nbf in blocks:
+            r = rot_by_type[uc.type_of_atom[ia]]
+            joff = off_by_atom[int(op.perm[ia])]
+            sl_i = slice(off, off + nbf)
+            sl_j = slice(joff, joff + nbf)
+            uu, dd, ud = dm3[0, sl_i, sl_i], dm3[1, sl_i, sl_i], dm3[2, sl_i, sl_i]
+            d0 = uu + dd
+            dvec = np.stack([ud + ud.conj().T, 1j * (ud - ud.conj().T), uu - dd])
+            d0r = r @ d0 @ r.T
+            dvr = np.einsum("ij,jab->iab", srot, [r @ c @ r.T for c in dvec])
+            out[0, sl_j, sl_j] += 0.5 * (d0r + dvr[2])
+            out[1, sl_j, sl_j] += 0.5 * (d0r - dvr[2])
+            out[2, sl_j, sl_j] += 0.5 * (dvr[0] - 1j * dvr[1])
     return out / sym.num_ops
 
 
@@ -246,3 +297,11 @@ def atomic_moments(ctx: SimulationContext, mag_g: np.ndarray) -> np.ndarray:
         phase = np.exp(2j * np.pi * (gv.millers @ uc.positions[ia]))
         out[ia] = float(np.real(mag_g @ (w * phase)))
     return out
+
+
+def atomic_moments_vec(ctx: SimulationContext, mvec_g: np.ndarray) -> np.ndarray:
+    """Per-atom (mx, my, mz) sphere integrals — vector form of
+    atomic_moments for non-collinear runs. mvec_g: [3, ng]."""
+    return np.stack(
+        [atomic_moments(ctx, mvec_g[i]) for i in range(3)], axis=1
+    )  # [natoms, 3]

@@ -107,7 +107,14 @@ def run_scf(
             f"(max {nb * ctx.max_occupancy * ctx.num_spins})"
         )
     if ctx.num_mag_dims == 3:
-        raise NotImplementedError("non-collinear magnetism is not implemented yet")
+        from sirius_tpu.dft.scf_nc import run_scf_nc
+
+        if restart_from or save_to or initial_state is not None or keep_state:
+            raise NotImplementedError(
+                "non-collinear SCF does not support checkpoint/warm-start "
+                "state passing yet"
+            )
+        return run_scf_nc(cfg, base_dir, ctx=ctx)
     polarized = ctx.num_mag_dims == 1
     # wave-function precision: fp32 runs the band solve in complex64
     # (reference precision_wf, dft_ground_state.cpp:216-304 fp32 SCF with

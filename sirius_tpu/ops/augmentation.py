@@ -168,10 +168,16 @@ def d_operator(
     aug: Augmentation,
     veff_g: np.ndarray,
     beta,  # BetaProjectors (bare D + packed block layout)
+    include_dion: bool = True,
 ) -> np.ndarray:
     """Full D matrix: bare D_ion plus the augmentation term
-    Omega sum_G conj(V_eff(G)) Q(G) e^{-i G r_a} per atom."""
-    d = beta.dion.copy()
+    Omega sum_G conj(V_eff(G)) Q(G) e^{-i G r_a} per atom.
+
+    include_dion=False returns the augmentation integral alone — the
+    magnetic-field components D(Bx/By/Bz) of the non-collinear D operator
+    (reference generate_d_operator_matrix.cpp loops iv over all field
+    components; only iv=0 carries the ionic part)."""
+    d = beta.dion.copy() if include_dion else np.zeros_like(beta.dion)
     omega = uc.omega
     vq_by_atom = {}
     for it, at in enumerate(aug.per_type):

@@ -57,6 +57,7 @@ def synthetic_silicon_context(
     use_symmetry: bool = True,
     positions: np.ndarray | None = None,
     extra_params: dict | None = None,
+    moments: np.ndarray | None = None,
 ) -> SimulationContext:
     """Diamond-Si-like 2-atom cell with the synthetic species."""
     import sirius_tpu.crystal.unit_cell as ucm
@@ -83,7 +84,7 @@ def synthetic_silicon_context(
         atom_types=[t],
         type_of_atom=np.array([0, 0], dtype=np.int32),
         positions=np.asarray(positions, dtype=np.float64),
-        moments=np.zeros((2, 3)),
+        moments=np.zeros((2, 3)) if moments is None else np.asarray(moments, float),
     )
     # SimulationContext.create reads species from files; build the parts
     # directly instead (same code path below the unit-cell level).
