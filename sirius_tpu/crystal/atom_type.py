@@ -34,6 +34,7 @@ class BetaProjector:
     l: int
     rbeta: np.ndarray  # r * beta(r) on the (possibly truncated) radial grid
     nr: int  # number of grid points carried
+    j: float | None = None  # total angular momentum (relativistic pseudos)
 
 
 @dataclasses.dataclass
@@ -70,6 +71,12 @@ class AtomType:
     paw: dict | None = None
     paw_core_energy: float = 0.0
     cutoff_radius_index: int | None = None  # PAW partial-wave truncation
+
+    @property
+    def spin_orbit(self) -> bool:
+        """Relativistic (j-resolved) projectors present (reference
+        atom_type spin_orbit_coupling, set from the UPF header)."""
+        return any(b.j is not None for b in self.beta)
 
     @property
     def num_beta(self) -> int:
@@ -115,7 +122,16 @@ class AtomType:
         betas = []
         for b in pp.get("beta_projectors", []):
             rb = np.asarray(b["radial_function"], dtype=np.float64)
-            betas.append(BetaProjector(l=int(b["angular_momentum"]), rbeta=rb, nr=len(rb)))
+            betas.append(
+                BetaProjector(
+                    l=int(b["angular_momentum"]), rbeta=rb, nr=len(rb),
+                    j=(
+                        float(b["total_angular_momentum"])
+                        if "total_angular_momentum" in b
+                        else None
+                    ),
+                )
+            )
         nb = len(betas)
         d_ion = np.asarray(pp.get("D_ion", np.zeros(nb * nb)), dtype=np.float64).reshape(nb, nb) if nb else np.zeros((0, 0))
         aug = []

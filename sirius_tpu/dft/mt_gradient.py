@@ -1,0 +1,130 @@
+"""Spectral gradient/divergence of muffin-tin (on-site) functions.
+
+A function f(x) = sum_lm f_lm(|x|) R_lm(x-hat) has an exact spectral
+cartesian gradient coupling l -> l+-1 channels with radial operators
+(d/dr - l/r) and (d/dr + (l+1)/r) and Clebsch-Gordan(l, 1, l+-1)
+coefficients — reference src/function3d/spheric_function.hpp:559-652
+(gradient/divergence in complex harmonics, converted to real harmonics).
+
+Real<->complex harmonic transforms are built NUMERICALLY from this
+package's own ylm_real/ylm_complex evaluations on an exact quadrature, so
+phase-convention mismatches are structurally impossible.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from sirius_tpu.core.sht import (
+    _sphere_quadrature,
+    lm_index,
+    num_lm,
+    ylm_complex,
+    ylm_real,
+)
+
+
+@lru_cache(maxsize=8)
+def _r2y_blocks(lmax: int):
+    """Per-l matrices C with R_lm(x) = sum_m' Y_lm'(x) C[m', m]; i.e. the
+    complex coefficients of a real expansion are fY = C @ fR per l block."""
+    pts, w = _sphere_quadrature(2 * lmax + 2)
+    Y = ylm_complex(lmax, pts)  # [npts, lmmax]
+    R = ylm_real(lmax, pts)
+    out = []
+    for l in range(lmax + 1):
+        idx = [lm_index(l, m) for m in range(-l, l + 1)]
+        Yl = Y[:, idx]
+        Rl = R[:, idx]
+        # C = <Y|R> with the quadrature inner product (Y orthonormal)
+        C = np.einsum("pi,p,pj->ij", np.conj(Yl), w, Rl)
+        out.append((idx, C))
+    return out
+
+
+def _cg_lp1(l: int, m: int, mu: int) -> float:
+    """<l m; 1 mu | l+1 m+mu> (closed form)."""
+    if mu == 1:
+        return np.sqrt((l + m + 1) * (l + m + 2) / ((2 * l + 1) * (2 * l + 2)))
+    if mu == 0:
+        return np.sqrt((l - m + 1) * (l + m + 1) / ((2 * l + 1) * (l + 1)))
+    return np.sqrt((l - m + 1) * (l - m + 2) / ((2 * l + 1) * (2 * l + 2)))
+
+
+def _cg_lm1(l: int, m: int, mu: int) -> float:
+    """<l m; 1 mu | l-1 m+mu> (closed form, Edmonds table for j2=1)."""
+    if mu == 1:
+        return np.sqrt((l - m) * (l - m - 1) / (2 * l * (2 * l + 1)))
+    if mu == 0:
+        return -np.sqrt((l - m) * (l + m) / (l * (2 * l + 1)))
+    return np.sqrt((l + m) * (l + m - 1) / (2 * l * (2 * l + 1)))
+
+
+def _gradient_lm_complex(fy: np.ndarray, r: np.ndarray, lmax: int) -> np.ndarray:
+    """Gradient of a complex-harmonic expansion fy [lmmax, nr] ->
+    [3(x,y,z), lmmax, nr] (reference spheric_function.hpp:559)."""
+    lmmax = num_lm(lmax)
+    g = np.zeros((3, lmmax, len(r)), dtype=np.complex128)  # (mu=+1, mu=-1, z)
+    dfy = np.gradient(fy, r, axis=-1)
+    rinv = 1.0 / r
+    for l in range(lmax + 1):
+        d1 = np.sqrt((l + 1) / (2 * l + 3))
+        d2 = np.sqrt(l / (2 * l - 1)) if l > 0 else 0.0
+        for m in range(-l, l + 1):
+            lm = lm_index(l, m)
+            s = fy[lm]
+            ds = dfy[lm]
+            for mu in (-1, 0, 1):
+                j = {1: 0, -1: 1, 0: 2}[mu]
+                if l + 1 <= lmax and abs(m + mu) <= l + 1:
+                    d = d1 * _cg_lp1(l, m, mu)
+                    g[j, lm_index(l + 1, m + mu)] += (ds - s * rinv * l) * d
+                if l - 1 >= 0 and abs(m + mu) <= l - 1:
+                    d = d2 * _cg_lm1(l, m, mu)
+                    g[j, lm_index(l - 1, m + mu)] -= (ds + s * rinv * (l + 1)) * d
+    gp, gm, gz = g
+    inv_sqrt2 = 1.0 / np.sqrt(2.0)
+    return np.stack([
+        (gm - gp) * inv_sqrt2,
+        1j * (gm + gp) * inv_sqrt2,
+        gz,
+    ])
+
+
+def _real_to_complex(fr: np.ndarray, lmax: int) -> np.ndarray:
+    fy = np.zeros(fr.shape, dtype=np.complex128)
+    for idx, C in _r2y_blocks(lmax):
+        fy[idx] = np.einsum("ij,j...->i...", C, fr[idx])
+    return fy
+
+
+def _complex_to_real(fy: np.ndarray, lmax: int) -> np.ndarray:
+    fr = np.zeros(fy.shape, dtype=np.complex128)
+    for idx, C in _r2y_blocks(lmax):
+        fr[idx] = np.einsum("ji,j...->i...", np.conj(C), fy[idx])
+    return np.real(fr)
+
+
+def gradient_lm_real(fr: np.ndarray, r: np.ndarray) -> np.ndarray:
+    """Cartesian gradient of a real-harmonic expansion fr [lmmax, nr] ->
+    [3, lmmax, nr] real-harmonic expansions (l channels above lmax are
+    truncated, like the reference)."""
+    lmax = int(np.sqrt(fr.shape[0])) - 1
+    fy = _real_to_complex(fr, lmax)
+    gy = _gradient_lm_complex(fy, r, lmax)
+    return np.stack([_complex_to_real(gy[i], lmax) for i in range(3)])
+
+
+def divergence_lm_real(w: np.ndarray, r: np.ndarray) -> np.ndarray:
+    """Divergence of a cartesian vector of real-harmonic expansions
+    w [3, lmmax, nr] -> [lmmax, nr] (reference divergence, sum of
+    gradient components)."""
+    lmax = int(np.sqrt(w.shape[1])) - 1
+    out = np.zeros(w.shape[1:])
+    for i in range(3):
+        fy = _real_to_complex(w[i], lmax)
+        gy = _gradient_lm_complex(fy, r, lmax)
+        out += _complex_to_real(gy[i], lmax)
+    return out

@@ -342,10 +342,88 @@ def xc_onsite(t: PawTypeData, rho_lm: np.ndarray, core: np.ndarray, xc):
 
 
 def xc_onsite_gga(t: PawTypeData, rho_lm: np.ndarray, core: np.ndarray, xc):
-    raise NotImplementedError(
-        "GGA on-site PAW exchange-correlation is not implemented yet "
-        "(LDA PAW decks are supported)"
+    """GGA XC on the radial x angular grid.
+
+    Channel densities are projected like the LDA case; their cartesian
+    gradients use the exact spectral muffin-tin gradient (dft/mt_gradient,
+    reference spheric_function.hpp:559 via xc_mt.cpp) padded one l higher so
+    no gradient content is truncated. The potential's -div(...) term is
+    assembled spectrally as well and evaluated with the same quadrature."""
+    import jax.numpy as jnp
+
+    from sirius_tpu.core.sht import num_lm, ylm_real
+    from sirius_tpu.dft.mt_gradient import divergence_lm_real, gradient_lm_real
+
+    nmag1 = rho_lm.shape[0]
+    lmax = int(np.sqrt(rho_lm.shape[1])) - 1
+    lmax_g = lmax + 1
+    lmmax_g = num_lm(lmax_g)
+    # quadrature dense enough for products at lmax_g (cached on the type)
+    rlm_g = getattr(t, "_rlm_gga", None)
+    if rlm_g is None:
+        from sirius_tpu.core.sht import _sphere_quadrature
+
+        pts, w = _sphere_quadrature(3 * lmax_g + 2)
+        rlm_g = ylm_real(lmax_g, pts)
+        t._rlm_gga = rlm_g
+        t._gga_w = w
+    w_pts = t._gga_w
+
+    def pad(f):
+        out = np.zeros((lmmax_g,) + f.shape[1:])
+        out[: f.shape[0]] = f
+        return out
+
+    rho0 = rho_lm[0].copy()
+    rho0[0] += core / Y00
+    if nmag1 == 2:
+        up_lm = 0.5 * pad(rho0 + rho_lm[1])
+        dn_lm = 0.5 * pad(rho0 - rho_lm[1])
+    else:
+        up_lm = dn_lm = 0.5 * pad(rho0)
+    gu = gradient_lm_real(up_lm, t.r)  # [3, lmmax_g, nr]
+    gd = gu if nmag1 == 1 else gradient_lm_real(dn_lm, t.r)
+
+    to_pt = lambda f_lm: rlm_g @ f_lm  # [npts, nr]
+    up = np.maximum(to_pt(up_lm), 1e-20)
+    dn = np.maximum(to_pt(dn_lm), 1e-20)
+    gu_pt = np.stack([to_pt(gu[i]) for i in range(3)])
+    gd_pt = gu_pt if nmag1 == 1 else np.stack([to_pt(gd[i]) for i in range(3)])
+    suu = np.sum(gu_pt**2, axis=0)
+    sud = np.sum(gu_pt * gd_pt, axis=0)
+    sdd = np.sum(gd_pt**2, axis=0)
+
+    shape = up.shape
+    out = xc.evaluate_polarized(
+        jnp.asarray(up.ravel()), jnp.asarray(dn.ravel()),
+        jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()),
+        jnp.asarray(sdd.ravel()),
     )
+    e = np.asarray(out["e"]).reshape(shape)
+    vu = np.asarray(out["v_up"]).reshape(shape)
+    vd = np.asarray(out["v_dn"]).reshape(shape)
+    vsuu = np.asarray(out["vsigma_uu"]).reshape(shape)
+    vsud = np.asarray(out["vsigma_ud"]).reshape(shape)
+    vsdd = np.asarray(out["vsigma_dd"]).reshape(shape)
+
+    proj_g = (w_pts[:, None] * rlm_g).T  # [lmmax_g, npts]
+    # W_s = 2 vsigma_ss grad n_s + vsigma_ud grad n_other; v_s -= div W_s
+    wu_lm = np.stack([proj_g @ (2.0 * vsuu * gu_pt[i] + vsud * gd_pt[i]) for i in range(3)])
+    wd_lm = np.stack([proj_g @ (2.0 * vsdd * gd_pt[i] + vsud * gu_pt[i]) for i in range(3)])
+    div_u = to_pt(divergence_lm_real(wu_lm, t.r))
+    div_d = to_pt(divergence_lm_real(wd_lm, t.r))
+    vu = vu - div_u
+    vd = vd - div_d
+
+    rho_pt = up + dn
+    eps = np.where(np.abs(rho_pt) > 1e-18, e / np.where(np.abs(rho_pt) > 1e-18, rho_pt, 1.0), 0.0)
+    lmmax = rho_lm.shape[1]
+    vxc = np.empty((nmag1, lmmax, len(t.r)))
+    vxc[0] = (proj_g @ (0.5 * (vu + vd)))[:lmmax]
+    if nmag1 == 2:
+        vxc[1] = (proj_g @ (0.5 * (vu - vd)))[:lmmax]
+    exc_lm = (proj_g @ eps)[:lmmax]
+    return vxc, exc_lm
 
 
 def compute_paw(paw: PawData, dm_flat: np.ndarray, xc):

@@ -109,10 +109,17 @@ def run_scf(
     if ctx.num_mag_dims == 3:
         from sirius_tpu.dft.scf_nc import run_scf_nc
 
-        if restart_from or save_to or initial_state is not None or keep_state:
+        if restart_from or initial_state is not None or keep_state:
             raise NotImplementedError(
                 "non-collinear SCF does not support checkpoint/warm-start "
                 "state passing yet"
+            )
+        if save_to:
+            import warnings
+
+            warnings.warn(
+                "non-collinear SCF does not write checkpoints yet; "
+                "save_to ignored"
             )
         return run_scf_nc(cfg, base_dir, ctx=ctx)
     polarized = ctx.num_mag_dims == 1
@@ -730,9 +737,12 @@ def run_scf_from_file(
         "git_hash": "",
         "comm_world_size": 1,
     }
-    print(json.dumps({"energy": result["energy"], "efermi": result["efermi"],
-                      "converged": result["converged"],
-                      "num_scf_iterations": result["num_scf_iterations"]}, indent=2))
+    summary = {"energy": result["energy"], "efermi": result["efermi"],
+               "converged": result["converged"],
+               "num_scf_iterations": result["num_scf_iterations"]}
+    if "magnetisation" in result:
+        summary["magnetisation"] = result["magnetisation"]
+    print(json.dumps(summary, indent=2))
     with open("output.json", "w") as f:
         json.dump(out, f, indent=2)
     if ref is not None:

@@ -112,10 +112,14 @@ def run_scf_nc(
     so = bool(getattr(p, "so_correction", False))
     so_data = None
     if so:
-        raise NotImplementedError(
-            "so_correction: spin-orbit D/Q blocks (ops/so) are not "
-            "implemented yet (ref non_local_operator.cpp:110-200)"
-        )
+        from sirius_tpu.ops.so import SpinOrbitData
+
+        so_data = SpinOrbitData.build(ctx)
+        if so_data is None:
+            raise ValueError(
+                "so_correction requested but no species has j-resolved "
+                "(relativistic) beta projectors"
+            )
 
     rho_g = initial_density_g(ctx)
     mvec_g = initial_magnetization_vec_g(ctx)
@@ -167,7 +171,7 @@ def run_scf_nc(
         if so_data is not None:
             # SO: blocks built from the j-resolved f-coefficients
             # (Eq. 19 PhysRevB.71.115106; non_local_operator.cpp:110-200)
-            dmat_blocks = so_data.d_blocks(d0, db)
+            dmat_blocks = so_data.d_blocks(np.asarray(d0), db)
             qmat_blocks = so_data.q_blocks()
         else:
             dmat_blocks = spin_blocks_from_components(d0, db[2], db[0], db[1])

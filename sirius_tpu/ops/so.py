@@ -1,0 +1,270 @@
+"""Spin-orbit coupling for relativistic (j-resolved) pseudopotentials.
+
+Fully-relativistic UPF files carry beta projectors labelled (l, j) with
+j = l +- 1/2; the non-local operator acts in the |l j mj> spherical-spinor
+basis. Everything reduces to the f-coefficients (Eq. 9 of PhysRevB 71,
+115106; reference atom_type.cpp generate_f_coefficients)
+
+  f^{s s'}_{xi1 xi2} = sum_{mj} U^s_{l j mj m1} CG(l, j, mj, s)
+                       conj(U^{s'}_{l j mj m2}) CG(l, j, mj, s')
+
+an angular-spinor overlap depending only on (l, j, m1, m2, s, s') — it
+vanishes unless (l1, j1) == (l2, j2). The D operator (Eq. 19, reference
+non_local_operator.cpp:110-200), the Q operator (Eq. 18, :285-340) and the
+<beta|psi> rotation in the density matrix (density.cpp:938-1000) are all
+congruences with this tensor restricted to the SAME radial function
+(compare_index_beta_functions), while the ionic dion term couples different
+radial functions of equal (l, j). Index order follows the reference
+verbatim; spin-block storage order here is (uu, dd, ud, du) — the
+reference's s_idx = {{0,3},{2,1}} and the local-operator 0/1/2/3 blocks.
+
+The real<->complex harmonic overlaps reuse this package's own transform
+blocks (dft/mt_gradient._r2y_blocks) so phase conventions are internally
+consistent with ops/beta.py's projector tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# pauli_matrix[alpha][s1][s2], alpha = (identity, z, x, y) — reference
+# core/constants.hpp:48
+PAULI = np.array([
+    [[1, 0], [0, 1]],
+    [[1, 0], [0, -1]],
+    [[0, 1], [1, 0]],
+    [[0, -1j], [1j, 0]],
+], dtype=np.complex128)
+
+
+def _clebsch_gordan(l: int, j: float, mj: float, spin: int) -> float:
+    """<l, mj-s; 1/2, s | j, mj> (reference sht.cpp:113 ClebschGordan)."""
+    denom = np.sqrt(1.0 / (2.0 * l + 1.0))
+    if abs(j - l - 0.5) < 1e-8:
+        m = int(round(mj - 0.5))
+        return denom * (np.sqrt(l + m + 1.0) if spin == 0 else np.sqrt(l - m))
+    if abs(j - l + 0.5) < 1e-8:
+        m = int(round(mj + 0.5))
+        if m < 1 - l:
+            return 0.0
+        return denom * (np.sqrt(l - m + 1) if spin == 0 else -np.sqrt(l + m))
+    raise ValueError(f"invalid (l={l}, j={j})")
+
+
+def _u_sigma_m(l: int, j: float, mj2: int, mp: int, sigma: int, C) -> complex:
+    """U^sigma_{l j mj, m'} (reference sht.cpp:165 calculate_U_sigma_m;
+    mj2 = 2*mj to stay integer). C = <Y_{l m1}|R_{l m2}> block."""
+
+    def rlm_dot_ylm(m1, m2):
+        # <R_{l m1}|Y_{l m2}> = conj(<Y_{l m2}|R_{l m1}>)
+        return np.conj(C[m2 + l, m1 + l])
+
+    if abs(j - l - 0.5) < 1e-8:
+        m1 = (mj2 - 1) >> 1
+        if sigma == 0:
+            return 0.0 if m1 < -l else rlm_dot_ylm(m1, mp)
+        return 0.0 if (m1 + 1) > l else rlm_dot_ylm(m1 + 1, mp)
+    if abs(j - l + 0.5) < 1e-8:
+        m1 = (mj2 + 1) >> 1
+        return rlm_dot_ylm(m1 - 1, mp) if sigma == 0 else rlm_dot_ylm(m1, mp)
+    raise ValueError(f"invalid (l={l}, j={j})")
+
+
+def f_coefficients(t) -> np.ndarray:
+    """[nbf, nbf, 2, 2] complex for one atom type with j-resolved betas."""
+    from sirius_tpu.dft.mt_gradient import _r2y_blocks
+
+    idx = []  # (idxrf, l, j, m) in ops/beta.py xi order
+    for ib, b in enumerate(t.beta):
+        for m in range(-b.l, b.l + 1):
+            idx.append((ib, b.l, b.j, m))
+    nbf = len(idx)
+    f = np.zeros((nbf, nbf, 2, 2), dtype=np.complex128)
+    cblocks = {}
+    for x2, (rf2, l2, j2, m2) in enumerate(idx):
+        for x1, (rf1, l1, j1, m1) in enumerate(idx):
+            if l1 != l2 or abs(j1 - j2) > 1e-8:
+                continue
+            if l1 not in cblocks:
+                cblocks[l1] = _r2y_blocks(l1)[l1][1]
+            C = cblocks[l1]
+            jj1 = int(round(2 * j1))
+            for s1 in (0, 1):
+                for s2 in (0, 1):
+                    c = 0.0 + 0.0j
+                    for mj2 in range(-jj1, jj1 + 1, 2):
+                        c += (
+                            _u_sigma_m(l1, j1, mj2, m1, s1, C)
+                            * _clebsch_gordan(l1, j1, mj2 / 2.0, s1)
+                            * np.conj(_u_sigma_m(l2, j2, mj2, m2, s2, C))
+                            * _clebsch_gordan(l2, j2, mj2 / 2.0, s2)
+                        )
+                    f[x1, x2, s1, s2] = c
+    return f
+
+
+@dataclasses.dataclass
+class SpinOrbitData:
+    """Per-type f tensors + masks, expanded over the global beta layout."""
+
+    f_by_type: list  # [nbf, nbf, 2, 2] complex or None per atom type
+    frf_by_type: list  # f masked to same radial function (congruence form)
+    dion_xi: list  # [nbf, nbf] dion expanded over xi on same-(l, j) pairs
+    dion_collinear: list  # [nbf, nbf] the collinear xi-expansion of dion
+    # (the piece inside the screened scalar D that must be removed before
+    # the Eq. 19 congruence)
+    qxi_by_type: list  # [nbf, nbf] q_mtrx in the xi basis (or None)
+    blocks: list  # (ia, offset, nbf) global layout
+    type_of_atom: np.ndarray
+
+    @staticmethod
+    def build(ctx) -> "SpinOrbitData | None":
+        uc = ctx.unit_cell
+        if not any(t.spin_orbit for t in uc.atom_types):
+            return None
+        ntypes = len(uc.atom_types)
+        f_by_type = [None] * ntypes
+        frf_by_type = [None] * ntypes
+        dion_xi = [None] * ntypes
+        dion_col = [None] * ntypes
+        qxi = [None] * ntypes
+        blocks = list(ctx.beta.atom_blocks(uc))
+        first_block_of_type = {}
+        for ia, off, nbf in blocks:
+            first_block_of_type.setdefault(int(uc.type_of_atom[ia]), (off, nbf))
+        for it, t in enumerate(uc.atom_types):
+            if ctx.beta.qmat is not None and it in first_block_of_type:
+                off, nbf = first_block_of_type[it]
+                qxi[it] = np.asarray(
+                    ctx.beta.qmat[off : off + nbf, off : off + nbf]
+                )
+            if not t.spin_orbit:
+                continue
+            f = f_coefficients(t)
+            meta = [
+                (ib, b.l, b.j) for ib, b in enumerate(t.beta)
+                for _ in range(2 * b.l + 1)
+            ]
+            same_rf = np.array([[a[0] == b_[0] for b_ in meta] for a in meta])
+            same_lj = np.array([[a[1:] == b_[1:] for b_ in meta] for a in meta])
+            rf = np.asarray([m[0] for m in meta])
+            f_by_type[it] = f
+            frf_by_type[it] = f * same_rf[:, :, None, None]
+            dion_xi[it] = t.d_ion[np.ix_(rf, rf)] * same_lj
+            off, nbf = first_block_of_type[it]
+            dion_col[it] = np.asarray(ctx.beta.dion[off : off + nbf, off : off + nbf])
+        return SpinOrbitData(
+            f_by_type=f_by_type,
+            frf_by_type=frf_by_type,
+            dion_xi=dion_xi,
+            dion_collinear=dion_col,
+            qxi_by_type=qxi,
+            blocks=blocks,
+            type_of_atom=uc.type_of_atom,
+        )
+
+    def _iter(self):
+        for ia, off, nbf in self.blocks:
+            it = int(self.type_of_atom[ia])
+            yield ia, off, nbf, it
+
+    def d_blocks(self, d0, db) -> np.ndarray:
+        """[4, nbeta_tot, nbeta_tot] complex blocks (uu, dd, ud, du).
+
+        d0: screened scalar D (bare dion + augmentation integral);
+        db: [D(Bx), D(By), D(Bz)] augmentation integrals (Nones if no
+        augmentation). SO atom blocks follow Eq. 19 verbatim; others get
+        the standard sigma.B assembly."""
+        from sirius_tpu.ops.spinor import spin_blocks_from_components
+
+        out = np.asarray(spin_blocks_from_components(d0, db[2], db[0], db[1]))
+        s_idx = [[0, 3], [2, 1]]
+        for ia, off, nbf, it in self._iter():
+            f = self.frf_by_type[it]
+            if f is None:
+                continue
+            sl = slice(off, off + nbf)
+            # augmentation components (V, Bz, Bx, By): subtract the bare
+            # ionic part from d0 — it enters through its own f term below
+            comp = [np.asarray(d0[sl, sl]) - self.dion_collinear[it]]
+            for c in (2, 0, 1):  # (Bz, Bx, By) from db = (Bx, By, Bz)
+                comp.append(
+                    np.zeros((nbf, nbf)) if db[c] is None else np.asarray(db[c][sl, sl])
+                )
+            dso = np.zeros((4, nbf, nbf), dtype=np.complex128)
+            for sig in (0, 1):
+                for sigp in (0, 1):
+                    acc = np.zeros((nbf, nbf), dtype=np.complex128)
+                    for a in range(4):
+                        for s1 in (0, 1):
+                            for s2 in (0, 1):
+                                p = PAULI[a][s1][s2]
+                                if p == 0:
+                                    continue
+                                acc += p * (
+                                    f[:, :, sig, s1] @ comp[a] @ f[:, :, s2, sigp]
+                                )
+                    dso[s_idx[sig][sigp]] = acc
+            # ionic contribution on same-(l, j) pairs (cross-radial allowed)
+            fi = self.f_by_type[it]
+            di = self.dion_xi[it]
+            dso[0] += di * fi[:, :, 0, 0]
+            dso[1] += di * fi[:, :, 1, 1]
+            dso[2] += di * fi[:, :, 0, 1]
+            dso[3] += di * fi[:, :, 1, 0]
+            for c in range(4):
+                out[c, sl, sl] = dso[c]
+        return out
+
+    def q_blocks(self) -> np.ndarray:
+        """[4, nbeta_tot, nbeta_tot] complex Q spin blocks (Eq. 18)."""
+        nbt = self.blocks[-1][1] + self.blocks[-1][2]
+        out = np.zeros((4, nbt, nbt), dtype=np.complex128)
+        any_aug = False
+        for ia, off, nbf, it in self._iter():
+            sl = slice(off, off + nbf)
+            q = self.qxi_by_type[it]
+            f = self.frf_by_type[it]
+            if q is None:
+                continue
+            any_aug = True
+            if f is None:
+                out[0, sl, sl] = q
+                out[1, sl, sl] = q
+                continue
+            for si in (0, 1):
+                for sj in (0, 1):
+                    acc = np.zeros((nbf, nbf), dtype=np.complex128)
+                    for s in (0, 1):
+                        acc += f[:, :, sj, s] @ q @ f[:, :, s, si]
+                    ind = si if si == sj else sj + 2
+                    out[ind, sl, sl] = acc
+        return out if any_aug else None
+
+    def rotate_dm(self, dm3: np.ndarray) -> np.ndarray:
+        """Rotate the (uu, dd, ud) spin density matrix for SO atoms:
+        dm_rot^{s s'} = sum_{t t'} f^{(rf)}[:, :, s, t] dm^{t t'}
+        f^{(rf)}[:, :, t', s'] (reference density.cpp:938-1000 bp1/bp2
+        rotation before the gemm)."""
+        out = dm3.copy()
+        for ia, off, nbf, it in self._iter():
+            f = self.frf_by_type[it]
+            if f is None:
+                continue
+            sl = slice(off, off + nbf)
+            uu, dd, ud = dm3[0, sl, sl], dm3[1, sl, sl], dm3[2, sl, sl]
+            dm = [[uu, ud], [ud.conj().T, dd]]
+            rot = {}
+            for sig in (0, 1):
+                for sigp in (0, 1):
+                    acc = np.zeros((nbf, nbf), dtype=np.complex128)
+                    for s in (0, 1):
+                        for s2 in (0, 1):
+                            acc += f[:, :, sig, s] @ dm[s][s2] @ f[:, :, s2, sigp]
+                    rot[(sig, sigp)] = acc
+            out[0, sl, sl] = rot[(0, 0)]
+            out[1, sl, sl] = rot[(1, 1)]
+            out[2, sl, sl] = rot[(0, 1)]
+        return out

@@ -150,3 +150,41 @@ def test_scf_lif_paw_kmesh_test04():
     np.testing.assert_allclose(
         np.asarray(res["forces"]), np.asarray(ref["forces"]), atol=1e-6
     )
+
+
+@requires_reference
+def test_xc_onsite_gga_variational():
+    """v_xc from the GGA on-site path must be the functional derivative of
+    E_xc: dE/dlam for rho + lam*drho equals int vxc drho r^2 dr dOmega
+    (validates the spectral gradient + divergence + quadrature chain)."""
+    from sirius_tpu.config import load_config
+    from sirius_tpu.context import SimulationContext
+    from sirius_tpu.dft.paw import PawData, Y00, _inner_lm, xc_onsite
+    from sirius_tpu.dft.xc import XCFunctional
+
+    cfg = load_config(os.path.join(BASE15, "sirius.json"))
+    ctx = SimulationContext.create(cfg, BASE15)
+    paw = PawData.build(ctx)
+    xc = XCFunctional(["XC_GGA_X_PBE", "XC_GGA_C_PBE"])
+    t = paw.types[1]
+    rng = np.random.default_rng(5)
+    rho_lm = np.zeros((1, t.lmmax_rho, len(t.r)))
+    rho_lm[0, 0] = 1.2 * np.exp(-t.r) / Y00
+    # small non-spherical content in the l=1,2 channels
+    for lm in range(1, min(9, t.lmmax_rho)):
+        rho_lm[0, lm] = 0.08 * rng.standard_normal() * t.r * np.exp(-1.5 * t.r)
+    drho = np.zeros_like(rho_lm)
+    for lm in range(min(9, t.lmmax_rho)):
+        drho[0, lm] = 0.03 * rng.standard_normal() * np.exp(-2.0 * t.r)
+
+    def exc_of(lam):
+        rl = rho_lm + lam * drho
+        vxc, exc = xc_onsite(t, rl, np.zeros_like(t.r), xc)
+        # exc is energy-per-particle expanded in lm; E = int exc * rho
+        return _inner_lm(t, exc, rl[0])
+
+    vxc, _ = xc_onsite(t, rho_lm, np.zeros_like(t.r), xc)
+    h = 1e-4
+    de_fd = (exc_of(h) - exc_of(-h)) / (2 * h)
+    de_v = _inner_lm(t, vxc[0], drho[0])
+    assert abs(de_fd - de_v) < 5e-6 * max(1.0, abs(de_fd)), (de_fd, de_v)

@@ -1,0 +1,70 @@
+"""Spin-orbit f-coefficient and D spin-block invariants (test10 Au species,
+fully-relativistic NC pseudo).
+
+The f tensor (Eq. 9 PhysRevB 71, 115106) is the projector from the
+m-resolved spinor space onto the |l j mj> subspace: it must be Hermitian,
+its spin-traced rank per (l, j) radial must be 2j+1, and the assembled
+D operator's spin-block matrix must be Hermitian with eigenvalues equal to
+the ionic D values at exactly 2j+1-fold multiplicity."""
+
+import collections
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_ROOT, requires_reference
+
+BASE10 = os.path.join(REFERENCE_ROOT, "verification", "test10")
+
+
+@pytest.fixture(scope="module")
+def au():
+    from sirius_tpu.config import load_config
+    from sirius_tpu.context import SimulationContext
+
+    cfg = load_config(os.path.join(BASE10, "sirius.json"))
+    ctx = SimulationContext.create(cfg, BASE10)
+    return ctx
+
+
+@requires_reference
+def test_f_coefficients_invariants(au):
+    from sirius_tpu.ops.so import f_coefficients
+
+    t = au.unit_cell.atom_types[0]
+    assert t.spin_orbit
+    f = f_coefficients(t)
+    for s in (0, 1):
+        for sp in (0, 1):
+            np.testing.assert_allclose(
+                f[:, :, s, sp], f[:, :, sp, s].conj().T, atol=1e-12
+            )
+    meta = [
+        (ib, b.l, b.j) for ib, b in enumerate(t.beta)
+        for _ in range(2 * b.l + 1)
+    ]
+    for ib, b in enumerate(t.beta):
+        xi = [i for i, m in enumerate(meta) if m[0] == ib]
+        tr = sum(np.trace(f[np.ix_(xi, xi)][:, :, s, s]).real for s in (0, 1))
+        assert abs(tr - (2 * b.j + 1)) < 1e-10
+
+
+@requires_reference
+def test_so_d_blocks_spectrum(au):
+    from sirius_tpu.ops.so import SpinOrbitData
+
+    so = SpinOrbitData.build(au)
+    t = au.unit_cell.atom_types[0]
+    blocks = so.d_blocks(np.asarray(au.beta.dion), [None, None, None])
+    nbf = blocks.shape[1]
+    m = np.block([[blocks[0], blocks[2]], [blocks[3], blocks[1]]])
+    np.testing.assert_allclose(m, m.conj().T, atol=1e-12)
+    ev = np.linalg.eigvalsh(m)
+    counts = collections.Counter(np.round(ev, 6))
+    # every distinct (l, j) dion channel appears with multiplicity 2j+1
+    expect = collections.Counter()
+    for ib, b in enumerate(t.beta):
+        expect[round(float(t.d_ion[ib, ib]), 6)] += int(2 * b.j + 1)
+    for val, mult in expect.items():
+        assert counts.get(val, 0) == mult, (val, mult, counts.get(val, 0))
