@@ -80,6 +80,38 @@ def _lda_c_pw_e(nu: jnp.ndarray, nd: jnp.ndarray) -> jnp.ndarray:
     return n * eps
 
 
+def _vwn_f(rs, a, x0, b, c):
+    """VWN5 Pade fit of a correlation-energy channel (Vosko-Wilk-Nusair
+    1980 Eq. 4.4; reference via libxc XC_LDA_C_VWN)."""
+    x = jnp.sqrt(rs)
+    X = lambda t: t * t + b * t + c
+    Q = jnp.sqrt(4.0 * c - b * b)
+    atn = jnp.arctan(Q / (2.0 * x + b))
+    return a * (
+        jnp.log(x * x / X(x))
+        + 2.0 * b / Q * atn
+        - b * x0 / X(x0) * (
+            jnp.log((x - x0) ** 2 / X(x))
+            + 2.0 * (b + 2.0 * x0) / Q * atn
+        )
+    )
+
+
+def _lda_c_vwn_e(nu: jnp.ndarray, nd: jnp.ndarray) -> jnp.ndarray:
+    """VWN5 correlation, full spin interpolation (same structure as PW92)."""
+    n = nu + nd
+    zeta = jnp.clip((nu - nd) / n, -1.0, 1.0)
+    rs = (3.0 / (4.0 * jnp.pi * n)) ** (1.0 / 3.0)
+    ec0 = _vwn_f(rs, 0.0310907, -0.10498, 3.72744, 12.9352)
+    ec1 = _vwn_f(rs, 0.01554535, -0.325, 7.06042, 18.0578)
+    alc = _vwn_f(rs, -1.0 / (6.0 * jnp.pi**2), -0.0047584, 1.13107, 13.0045)
+    fz = _zeta_f(zeta)
+    fpp0 = 8.0 / (9.0 * (2.0 ** (4.0 / 3.0) - 2.0))
+    z4 = zeta**4
+    eps = ec0 + alc * fz / fpp0 * (1 - z4) + (ec1 - ec0) * fz * z4
+    return n * eps
+
+
 _PBE_KAPPA = 0.804
 _PBE_MU = 0.2195149727645171
 _PBE_BETA = 0.06672455060314922
@@ -135,6 +167,7 @@ _LDA_FUNCS = {
     "XC_LDA_X": _lda_x_e,
     "XC_LDA_C_PZ": _lda_c_pz_e,
     "XC_LDA_C_PW": _lda_c_pw_e,
+    "XC_LDA_C_VWN": _lda_c_vwn_e,
 }
 _GGA_FUNCS = {
     "XC_GGA_X_PBE": _pbe_x_e,

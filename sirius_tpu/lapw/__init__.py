@@ -1,0 +1,2 @@
+"""FP-LAPW subsystem: radial solvers, APW matching, first-variational
+Hamiltonian (reference src/radial, src/lapw, src/hamiltonian/diagonalize_fp)."""

@@ -1,0 +1,255 @@
+"""Radial Schrödinger / scalar-relativistic / Dirac solvers.
+
+Reference: src/radial/radial_solver.hpp. The second-order radial problem
+for p(r) = u(r) r decouples into
+
+  p'(r) = 2 M q(r) + p(r)/r           (+ energy-derivative source terms)
+  q'(r) = (V - E + l(l+1)/(2 M r^2)) p(r) - q(r)/r - chi(r)
+
+with the relativistic mass M = 1 (none), 1 + a^2/2 (E - V) (Koelling-
+Harmon), 1 - a^2/2 V (ZORA), M0/(1 - a^2 E / (2 M0)) (IORA). The first
+energy derivative solves the same system with source terms (reference
+radial_solver.hpp:136-200). The 4-component Dirac radial system for core
+states is
+
+  P' = -(kappa/r) P + a (E - V + 2/a^2) Q
+  Q' =  (kappa/r) Q - a (E - V) P
+
+Integration is RK4 on the species' own (nonuniform) grid with the
+potential presampled at the nodes and interval midpoints (one spline pass
+per grid, not per step); bound states use node-count bisection. All in
+Hartree atomic units (c = 137.035999139).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SPEED_OF_LIGHT = 137.035999139
+ALPHA = 1.0 / SPEED_OF_LIGHT
+SQ_ALPHA_HALF = 0.5 * ALPHA * ALPHA
+
+RELATIVITIES = ("none", "koelling_harmon", "zora", "iora", "dirac")
+
+
+def _with_midpoints(r, f):
+    """[2n-1] array of f at nodes and interval midpoints (spline once)."""
+    from sirius_tpu.core.radial import Spline
+
+    s = Spline(r, f)
+    mid = 0.5 * (r[:-1] + r[1:])
+    out = np.empty(2 * len(r) - 1)
+    out[0::2] = f
+    out[1::2] = s(mid)
+    return out
+
+
+def _mass(rel: str, E: float, v):
+    if rel == "none":
+        return np.ones_like(v)
+    if rel == "koelling_harmon":
+        return 1.0 + SQ_ALPHA_HALF * (E - v)
+    if rel == "zora":
+        return 1.0 - SQ_ALPHA_HALF * v
+    if rel == "iora":
+        m0 = 1.0 - SQ_ALPHA_HALF * v
+        return m0 / (1.0 - SQ_ALPHA_HALF * E / m0)
+    raise ValueError(rel)
+
+
+def integrate_outward(r, veff, l: int, E: float, rel: str = "none",
+                      p_prev=None, q_prev=None, mderiv: int = 0,
+                      v2=None):
+    """RK4 outward integration. Returns (p, q, num_nodes).
+
+    p_prev/q_prev: (2n-1)-sampled previous-order arrays for mderiv=1 (use
+    _with_midpoints); v2: optional presampled potential (2n-1) to amortize
+    the spline across bisection iterations."""
+    if rel == "dirac":
+        raise ValueError("use find_bound_state_dirac for Dirac")
+    n = len(r)
+    if v2 is None:
+        v2 = _with_midpoints(r, veff)
+    r2 = np.empty(2 * n - 1)
+    r2[0::2] = r
+    r2[1::2] = 0.5 * (r[:-1] + r[1:])
+    m2 = _mass(rel, E, v2)
+    ll2 = 0.5 * l * (l + 1)
+    # coefficient arrays at the 2n-1 sample points
+    a_pq = 2.0 * m2                      # p' = a_pq q + p/r
+    a_qp = v2 - E + ll2 / (m2 * r2 * r2)  # q' = a_qp p - q/r (- sources)
+    inv_r = 1.0 / r2
+    kh = rel in ("koelling_harmon", "iora")
+    if mderiv == 1:
+        src_p = ALPHA * ALPHA * q_prev if kh else np.zeros_like(v2)
+        src_q = -(1.0 + ll2 * ALPHA * ALPHA / (2.0 * m2 * m2 * r2 * r2)) * p_prev if kh \
+            else -p_prev
+    p = np.empty(n)
+    q = np.empty(n)
+    p[0] = r[0] ** (l + 1)
+    q[0] = 0.5 * l * r[0] ** l
+    yp, yq = p[0], q[0]
+    nodes = 0
+
+    def f(i2, pp, qq):
+        dp = a_pq[i2] * qq + pp * inv_r[i2]
+        dq = a_qp[i2] * pp - qq * inv_r[i2]
+        if mderiv == 1:
+            dp += src_p[i2]
+            dq += src_q[i2]
+        return dp, dq
+
+    for i in range(n - 1):
+        h = r[i + 1] - r[i]
+        i0, im, i1 = 2 * i, 2 * i + 1, 2 * i + 2
+        k1p, k1q = f(i0, yp, yq)
+        k2p, k2q = f(im, yp + 0.5 * h * k1p, yq + 0.5 * h * k1q)
+        k3p, k3q = f(im, yp + 0.5 * h * k2p, yq + 0.5 * h * k2q)
+        k4p, k4q = f(i1, yp + h * k3p, yq + h * k3q)
+        yp_new = yp + (h / 6.0) * (k1p + 2 * k2p + 2 * k3p + k4p)
+        yq = yq + (h / 6.0) * (k1q + 2 * k2q + 2 * k3q + k4q)
+        if abs(yp_new) > 1e60 or abs(yq) > 1e60:
+            s = max(abs(yp_new), abs(yq))
+            yp_new /= s
+            yq /= s
+            p[: i + 1] /= s
+            q[: i + 1] /= s
+        if yp_new * yp < 0:
+            nodes += 1
+        yp = yp_new
+        p[i + 1] = yp
+        q[i + 1] = yq
+    return p, q, nodes
+
+
+def surface_derivatives(r, veff, l: int, E: float, rel: str = "none"):
+    """(u(R), u'(R), p, q): boundary values for APW matching.
+
+    u = p/r; u' = (p' - u)/r = 2 M q / r (from the p' equation)."""
+    p, q, _ = integrate_outward(r, veff, l, E, rel)
+    R = r[-1]
+    m = float(_mass(rel, E, np.asarray([veff[-1]]))[0])
+    return p[-1] / R, 2.0 * m * q[-1] / R, p, q
+
+
+def find_bound_state(r, veff, l: int, n: int, rel: str = "none",
+                     e_lo: float = -200.0, e_hi: float = 10.0,
+                     tol: float = 1e-10, max_iter: int = 200):
+    """Bound state with principal quantum number n (n - l - 1 nodes) by
+    node-count bisection. Returns (E, u(r) normalized to int u^2 r^2 = 1)."""
+    target_nodes = n - l - 1
+    assert target_nodes >= 0
+    v2 = _with_midpoints(r, veff)
+    lo, hi = e_lo, e_hi
+    for _ in range(max_iter):
+        mid = 0.5 * (lo + hi)
+        _, _, nd = integrate_outward(r, veff, l, mid, rel, v2=v2)
+        if nd > target_nodes:
+            hi = mid
+        else:
+            lo = mid
+        if hi - lo < tol * max(1.0, abs(lo)):
+            break
+    E = 0.5 * (lo + hi)
+    p, _, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
+    u = p / r
+    nrm = np.sqrt(np.trapezoid(p * p, r))
+    return E, u / nrm
+
+
+def find_bound_state_dirac(r, veff, n: int, kappa: int,
+                           e_lo: float = -5000.0, e_hi: float = 10.0,
+                           tol: float = 1e-10, max_iter: int = 250):
+    """Dirac bound state (deep core levels). kappa = -(l+1) for
+    j = l + 1/2, kappa = l for j = l - 1/2; energies exclude the rest
+    mass. Returns (E, g(r), f(r)) with int (g^2 + f^2) r^2 = 1."""
+    l = kappa if kappa > 0 else -kappa - 1
+    target_nodes = n - l - 1
+    v2 = _with_midpoints(r, veff)
+    nmax = len(r)
+    r2 = np.empty(2 * nmax - 1)
+    r2[0::2] = r
+    r2[1::2] = 0.5 * (r[:-1] + r[1:])
+    inv_r = 1.0 / r2
+    two_c2 = 2.0 / (ALPHA * ALPHA)
+
+    # relativistic indicial series: P ~ r^gamma, Q0/P0 = (gamma+kappa)/(z a)
+    # with gamma = sqrt(kappa^2 - (z a)^2) (point-nucleus behavior; FP
+    # muffin-tin potentials are always nuclear-singular at the origin)
+    zeff = max(-veff[0] * r[0], 1e-8)
+    gamma = np.sqrt(max(kappa * kappa - (zeff * ALPHA) ** 2, 1e-12))
+
+    def integrate(E):
+        aPQ = ALPHA * (E - v2 + two_c2)
+        aQP = -ALPHA * (E - v2)
+        P = np.empty(nmax)
+        Q = np.empty(nmax)
+        P[0] = r[0] ** gamma
+        Q[0] = P[0] * (gamma + kappa) / (zeff * ALPHA)
+        yp, yq = P[0], Q[0]
+        nodes = 0
+
+        def f(i2, pp, qq):
+            return (
+                -kappa * inv_r[i2] * pp + aPQ[i2] * qq,
+                kappa * inv_r[i2] * qq + aQP[i2] * pp,
+            )
+
+        for i in range(nmax - 1):
+            h = r[i + 1] - r[i]
+            i0, im, i1 = 2 * i, 2 * i + 1, 2 * i + 2
+            k1p, k1q = f(i0, yp, yq)
+            k2p, k2q = f(im, yp + 0.5 * h * k1p, yq + 0.5 * h * k1q)
+            k3p, k3q = f(im, yp + 0.5 * h * k2p, yq + 0.5 * h * k2q)
+            k4p, k4q = f(i1, yp + h * k3p, yq + h * k3q)
+            yp_new = yp + (h / 6.0) * (k1p + 2 * k2p + 2 * k3p + k4p)
+            yq = yq + (h / 6.0) * (k1q + 2 * k2q + 2 * k3q + k4q)
+            if abs(yp_new) > 1e60 or abs(yq) > 1e60:
+                s = max(abs(yp_new), abs(yq))
+                yp_new /= s
+                yq /= s
+                P[: i + 1] /= s
+                Q[: i + 1] /= s
+            if yp_new * yp < 0:
+                nodes += 1
+            yp = yp_new
+            P[i + 1] = yp
+            Q[i + 1] = yq
+        return P, Q, nodes
+
+    lo, hi = e_lo, e_hi
+    for _ in range(max_iter):
+        mid = 0.5 * (lo + hi)
+        if integrate(mid)[2] > target_nodes:
+            hi = mid
+        else:
+            lo = mid
+        if hi - lo < tol * max(1.0, abs(lo)):
+            break
+    E = 0.5 * (lo + hi)
+    P, Q, _ = integrate(E)
+    nrm = np.sqrt(np.trapezoid(P * P + Q * Q, r))
+    return E, (P / nrm) / r, (Q / nrm) / r
+
+
+def radial_solution_with_edot(r, veff, l: int, E: float, rel: str = "none"):
+    """(u, udot, u(R), u'(R), udot(R), udot'(R)): the LAPW linearization
+    pair. udot solves the inhomogeneous system with the m=1 source and is
+    orthogonalized against u (reference Radial_solver::solve m=1 +
+    Atom_symmetry_class orthogonalization)."""
+    p, q, _ = integrate_outward(r, veff, l, E, rel)
+    nrm = np.sqrt(np.trapezoid(p * p, r))
+    p, q = p / nrm, q / nrm
+    pd, qd, _ = integrate_outward(
+        r, veff, l, E, rel,
+        p_prev=_with_midpoints(r, p), q_prev=_with_midpoints(r, q), mderiv=1,
+    )
+    ov = np.trapezoid(p * pd, r)
+    pd = pd - ov * p
+    qd = qd - ov * q
+    R = r[-1]
+    m = float(_mass(rel, E, np.asarray([veff[-1]]))[0])
+    kh_extra = ALPHA * ALPHA * q[-1] if rel in ("koelling_harmon", "iora") else 0.0
+    u, up = p[-1] / R, 2.0 * m * q[-1] / R
+    ud, udp = pd[-1] / R, (2.0 * m * qd[-1] + kh_extra) / R
+    return p / r, pd / r, u, up, ud, udp

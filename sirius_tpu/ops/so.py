@@ -39,69 +39,76 @@ PAULI = np.array([
 ], dtype=np.complex128)
 
 
-def _clebsch_gordan(l: int, j: float, mj: float, spin: int) -> float:
-    """<l, mj-s; 1/2, s | j, mj> (reference sht.cpp:113 ClebschGordan)."""
-    denom = np.sqrt(1.0 / (2.0 * l + 1.0))
-    if abs(j - l - 0.5) < 1e-8:
-        m = int(round(mj - 0.5))
-        return denom * (np.sqrt(l + m + 1.0) if spin == 0 else np.sqrt(l - m))
-    if abs(j - l + 0.5) < 1e-8:
-        m = int(round(mj + 0.5))
-        if m < 1 - l:
-            return 0.0
-        return denom * (np.sqrt(l - m + 1) if spin == 0 else -np.sqrt(l + m))
-    raise ValueError(f"invalid (l={l}, j={j})")
+def _l_matrices_real(l: int):
+    """Angular-momentum operators (Lx, Ly, Lz) in THIS package's real-
+    harmonic basis: built exactly in the complex basis (Lz|Y_m> = m|Y_m>,
+    L+- with sqrt(l(l+1) - m(m+-1))) and transformed with the numerically-
+    derived real<->complex block C (R_m2 = sum_m1 Y_m1 C[m1, m2]) — no
+    rotation-matrix sign conventions involved."""
+    from sirius_tpu.dft.mt_gradient import _r2y_blocks
+
+    n = 2 * l + 1
+    m = np.arange(-l, l + 1)
+    lz = np.diag(m.astype(float))
+    lp = np.zeros((n, n))
+    for mm in range(-l, l):
+        # L+|l m> = sqrt(l(l+1) - m(m+1)) |l m+1>
+        lp[mm + 1 + l, mm + l] = np.sqrt(l * (l + 1) - mm * (mm + 1))
+    lm = lp.T
+    lx = 0.5 * (lp + lm)
+    ly = -0.5j * (lp - lm)
+    C = _r2y_blocks(l)[l][1]
+    return [C.conj().T @ op @ C for op in (lx, ly, lz)], C
 
 
-def _u_sigma_m(l: int, j: float, mj2: int, mp: int, sigma: int, C) -> complex:
-    """U^sigma_{l j mj, m'} (reference sht.cpp:165 calculate_U_sigma_m;
-    mj2 = 2*mj to stay integer). C = <Y_{l m1}|R_{l m2}> block."""
-
-    def rlm_dot_ylm(m1, m2):
-        # <R_{l m1}|Y_{l m2}> = conj(<Y_{l m2}|R_{l m1}>)
-        return np.conj(C[m2 + l, m1 + l])
-
-    if abs(j - l - 0.5) < 1e-8:
-        m1 = (mj2 - 1) >> 1
-        if sigma == 0:
-            return 0.0 if m1 < -l else rlm_dot_ylm(m1, mp)
-        return 0.0 if (m1 + 1) > l else rlm_dot_ylm(m1 + 1, mp)
-    if abs(j - l + 0.5) < 1e-8:
-        m1 = (mj2 + 1) >> 1
-        return rlm_dot_ylm(m1 - 1, mp) if sigma == 0 else rlm_dot_ylm(m1, mp)
-    raise ValueError(f"invalid (l={l}, j={j})")
+def j_projector(l: int, j: float) -> np.ndarray:
+    """[(2l+1), (2l+1), 2, 2] projector onto the |l j mj> subspace in the
+    real-harmonic x spin basis: the spectral projector of J^2 = (L + S)^2
+    at eigenvalue j(j+1). Convention-proof by construction — it only uses
+    Lz|Y_m> = m|Y_m> and the package's own real<->complex transform."""
+    L, _ = _l_matrices_real(l)
+    n = 2 * l + 1
+    S = [
+        0.5 * np.array([[0, 1], [1, 0]], dtype=complex),
+        0.5 * np.array([[0, -1j], [1j, 0]], dtype=complex),
+        0.5 * np.array([[1, 0], [0, -1]], dtype=complex),
+    ]
+    # combined index (s, m) with spin-major kron (s*n + m)
+    J = [np.kron(np.eye(2), L[i]) + np.kron(S[i], np.eye(n)) for i in range(3)]
+    j2 = sum(Ji @ Ji for Ji in J)
+    ev, v = np.linalg.eigh(j2)
+    sel = np.abs(ev - j * (j + 1)) < 1e-8
+    assert sel.sum() == int(round(2 * j + 1)), (l, j, ev)
+    p = v[:, sel] @ v[:, sel].conj().T  # [(2n), (2n)] spin-major
+    # reshape to [m1, m2, s1, s2]
+    p4 = p.reshape(2, n, 2, n)
+    return np.transpose(p4, (1, 3, 0, 2))
 
 
 def f_coefficients(t) -> np.ndarray:
-    """[nbf, nbf, 2, 2] complex for one atom type with j-resolved betas."""
-    from sirius_tpu.dft.mt_gradient import _r2y_blocks
-
+    """[nbf, nbf, 2, 2] complex for one atom type with j-resolved betas:
+    f^{s s'}_{xi1 xi2} = <R_{m1} s| P_{l j} |R_{m2} s'> on same-(l, j)
+    pairs — the angular-spinor overlap of Eq. 9 PhysRevB 71, 115106,
+    constructed as the J^2 spectral projector in this package's own basis
+    (the reference builds the same object from U and Clebsch-Gordan
+    tables in ITS real-harmonic convention, atom_type.cpp
+    generate_f_coefficients)."""
     idx = []  # (idxrf, l, j, m) in ops/beta.py xi order
     for ib, b in enumerate(t.beta):
         for m in range(-b.l, b.l + 1):
             idx.append((ib, b.l, b.j, m))
     nbf = len(idx)
     f = np.zeros((nbf, nbf, 2, 2), dtype=np.complex128)
-    cblocks = {}
+    pcache = {}
     for x2, (rf2, l2, j2, m2) in enumerate(idx):
         for x1, (rf1, l1, j1, m1) in enumerate(idx):
             if l1 != l2 or abs(j1 - j2) > 1e-8:
                 continue
-            if l1 not in cblocks:
-                cblocks[l1] = _r2y_blocks(l1)[l1][1]
-            C = cblocks[l1]
-            jj1 = int(round(2 * j1))
-            for s1 in (0, 1):
-                for s2 in (0, 1):
-                    c = 0.0 + 0.0j
-                    for mj2 in range(-jj1, jj1 + 1, 2):
-                        c += (
-                            _u_sigma_m(l1, j1, mj2, m1, s1, C)
-                            * _clebsch_gordan(l1, j1, mj2 / 2.0, s1)
-                            * np.conj(_u_sigma_m(l2, j2, mj2, m2, s2, C))
-                            * _clebsch_gordan(l2, j2, mj2 / 2.0, s2)
-                        )
-                    f[x1, x2, s1, s2] = c
+            key = (l1, j1)
+            if key not in pcache:
+                pcache[key] = j_projector(l1, j1)
+            p = pcache[key]
+            f[x1, x2] = p[m1 + l1, m2 + l2]
     return f
 
 

@@ -1,0 +1,75 @@
+"""Radial solver validation against analytic hydrogen-like results
+(reference src/radial/radial_solver.hpp; the reference validates the same
+way in apps/tests and apps/atoms).
+
+- Schrödinger hydrogen: E_nl = -Z^2 / (2 n^2), any l < n.
+- Dirac hydrogen: Sommerfeld fine-structure formula.
+- LAPW linearization pair: <u|udot> = 0 and the Wronskian identity
+  u'(R) udot(R) - u(R) udot'(R) = 2/R^2 (normalization of the energy
+  derivative, non-relativistic case).
+"""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.lapw.radial_solver import (
+    ALPHA,
+    find_bound_state,
+    find_bound_state_dirac,
+    radial_solution_with_edot,
+)
+
+
+def _grid(rmax=40.0, n=2500, rmin=1e-6):
+    t = np.linspace(np.log(rmin), np.log(rmax), n)
+    return np.exp(t)
+
+
+def test_hydrogen_schroedinger_levels():
+    r = _grid()
+    v = -1.0 / r
+    for n, l in ((1, 0), (2, 0), (2, 1), (3, 1), (3, 2)):
+        e, u = find_bound_state(r, v, l, n)
+        assert abs(e + 0.5 / n**2) < 2e-6, (n, l, e)
+        # normalized: int u^2 r^2 = 1
+        assert abs(np.trapezoid(u * u * r * r, r) - 1.0) < 1e-8
+
+
+def test_hydrogenlike_z10_level():
+    r = _grid(rmax=6.0)
+    z = 10.0
+    v = -z / r
+    e, _ = find_bound_state(r, v, 0, 1)
+    assert abs(e + z * z / 2.0) < 2e-4
+
+
+def test_dirac_hydrogen_fine_structure():
+    z = 20.0
+    r = _grid(rmax=8.0, n=3000, rmin=1e-7)
+    v = -z / r
+    c = 1.0 / ALPHA
+
+    def sommerfeld(n, kappa):
+        g = np.sqrt(kappa**2 - (z * ALPHA) ** 2)
+        arg = z * ALPHA / (n - abs(kappa) + g)
+        return c**2 * (1.0 / np.sqrt(1.0 + arg**2) - 1.0)
+
+    for n, kappa in ((1, -1), (2, -1), (2, 1), (2, -2)):
+        e, g_, f_ = find_bound_state_dirac(r, v, n, kappa)
+        e_ref = sommerfeld(n, kappa)
+        assert abs(e - e_ref) < 5e-4 * max(1.0, abs(e_ref)), (n, kappa, e, e_ref)
+
+
+def test_lapw_linearization_pair_wronskian():
+    r = _grid(rmax=2.0, n=1500)
+    v = -3.0 / r + 0.2 * r  # confining-ish muffin-tin potential
+    for l in (0, 1, 2):
+        u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v, l, -0.3)
+        # orthogonality <u|udot> r^2
+        ov = np.trapezoid(u * ud * r * r, r)
+        assert abs(ov) < 1e-10
+        # Wronskian identity at the sphere boundary (non-relativistic):
+        # R^2 (u'(R) udot(R) - u(R) udot'(R)) = 2... normalization -1
+        w = uR * udpR - upR * udR
+        R = r[-1]
+        assert abs(w * R * R - (-2.0)) < 5e-3, (l, w * R * R)

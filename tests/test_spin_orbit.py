@@ -68,3 +68,51 @@ def test_so_d_blocks_spectrum(au):
         expect[round(float(t.d_ion[ib, ib]), 6)] += int(2 * b.j + 1)
     for val, mult in expect.items():
         assert counts.get(val, 0) == mult, (val, mult, counts.get(val, 0))
+
+
+@requires_reference
+def test_so_d_eigenspaces_have_pure_j_character(au):
+    """Each eigenspace of the assembled D operator must be a pure J^2
+    eigenspace with j matching its dion channel (catches any real-harmonic
+    convention mismatch in the f tensor — a swapped/conjugated block keeps
+    the spectrum but mixes j characters)."""
+    from sirius_tpu.ops.so import SpinOrbitData, _l_matrices_real
+
+    so = SpinOrbitData.build(au)
+    t = au.unit_cell.atom_types[0]
+    blocks = so.d_blocks(np.asarray(au.beta.dion), [None, None, None])
+    nbf = blocks.shape[1]
+    m = np.block([[blocks[0], blocks[2]], [blocks[3], blocks[1]]])
+    # J^2 in the same spin-major layout, built from the ladder operators
+    lmax = max(b.l for b in t.beta)
+    Lfull = [np.zeros((nbf, nbf), dtype=complex) for _ in range(3)]
+    pos = 0
+    for b in t.beta:
+        n = 2 * b.l + 1
+        L, _ = _l_matrices_real(b.l)
+        for i in range(3):
+            Lfull[i][pos : pos + n, pos : pos + n] = L[i]
+        pos += n
+    S = [
+        0.5 * np.array([[0, 1], [1, 0]], dtype=complex),
+        0.5 * np.array([[0, -1j], [1j, 0]], dtype=complex),
+        0.5 * np.array([[1, 0], [0, -1]], dtype=complex),
+    ]
+    J = [
+        np.kron(np.eye(2), Lfull[i]) + np.kron(S[i], np.eye(nbf))
+        for i in range(3)
+    ]
+    j2 = sum(Ji @ Ji for Ji in J)
+    ev, v = np.linalg.eigh(m)
+    vals = np.round(ev, 6)
+    jval_by_dion = {}
+    for ib, b in enumerate(t.beta):
+        jval_by_dion[round(float(t.d_ion[ib, ib]), 6)] = b.j
+    for val in set(vals):
+        if val == 0 or val not in jval_by_dion:
+            continue
+        idx = np.where(vals == val)[0]
+        sub = v[:, idx]
+        got = np.real(np.trace(sub.conj().T @ j2 @ sub) / len(idx))
+        j = jval_by_dion[val]
+        assert abs(got - j * (j + 1)) < 1e-8, (val, j, got)
