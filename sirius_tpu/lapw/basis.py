@@ -1,0 +1,220 @@
+"""APW(+lo) radial basis and plane-wave matching coefficients.
+
+Reference: src/unit_cell/atom_symmetry_class.cpp (radial function
+generation), src/lapw/matching_coefficients.hpp:42 (A_lm coefficients).
+
+Every MT radial function f is stored together with hf := (T + V_sph) f
+evaluated THROUGH the radial ODE (no numerical second derivative):
+for u at linearization energy E, hu = E u; for udot, hud = E udot + u;
+for a local orbital c1 u + c2 udot, hf = E f + c2 u. Spherical-potential
+Hamiltonian integrals then become plain radial overlaps, symmetrized as
+(1/2)(<g|hf> + <hg|f>) — the Hermitian LAPW assembly on the truncated
+sphere domain.
+
+LAPW order-2 matching at the sphere boundary: the interstitial plane wave
+(1/sqrt(Omega)) e^{i(G+k).r} expands around atom a as
+
+  (4 pi / sqrt(Omega)) e^{i(G+k).r_a} sum_lm i^l j_l(|G+k| r)
+      Y*_lm(G+k-hat) Y_lm(r-hat)
+
+and the MT function a u_l(r) + b udot_l(r) matches value AND slope.
+
+Local orbitals combine two radial functions with zero value at R and unit
+norm (reference lo descriptors with p(R) = 0 boundary condition)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from sirius_tpu.core.sht import lm_index, num_lm, ylm_complex
+from sirius_tpu.lapw.radial_solver import (
+    find_bound_state,
+    radial_solution_with_edot,
+)
+
+
+@dataclasses.dataclass
+class MtRadial:
+    """One MT radial function with its spherical-Hamiltonian image."""
+
+    l: int
+    f: np.ndarray  # u(r)
+    hf: np.ndarray  # (T + V_sph) u via the ODE
+    fR: float  # u(R)
+    fpR: float  # u'(R)
+
+
+@dataclasses.dataclass
+class AtomRadialBasis:
+    """Per-atom-type radial functions at the current spherical potential.
+
+    aw[l] = [MtRadial u, MtRadial udot] (the LAPW pair); lo = [MtRadial]
+    with zero boundary value."""
+
+    lmax_apw: int
+    r: np.ndarray
+    aw: list
+    lo: list
+    enu: list
+
+    def overlap(self, f1: MtRadial, f2: MtRadial) -> float:
+        return float(np.trapezoid(f1.f * f2.f * self.r**2, self.r))
+
+    def h_sph(self, f1: MtRadial, f2: MtRadial) -> float:
+        """Symmetrized spherical-Hamiltonian integral INCLUDING the kinetic
+        surface term: the interstitial matrix elements use the gradient
+        (weak) form, so the MT side must too; converting the volume
+        Laplacian form (what the ODE images hf encode) to the gradient form
+        adds (1/4) R^2 (f1(R) f2'(R) + f1'(R) f2(R)) after symmetrization
+        (reference: the APW surface contribution in set_fv_h_o,
+        hamiltonian.hpp — the a^* b u u' boundary term)."""
+        r2 = self.r**2
+        vol = 0.5 * float(
+            np.trapezoid(f1.f * f2.hf * r2, self.r)
+            + np.trapezoid(f1.hf * f2.f * r2, self.r)
+        )
+        R = self.r[-1]
+        surf = 0.25 * R * R * (f1.fR * f2.fpR + f1.fpR * f2.fR)
+        return vol + surf
+
+
+def find_enu(r, v_sph, l: int, n: int, rel: str = "none") -> float:
+    """Linearization energy: bound-state energy of the spherical potential
+    at principal quantum number n (reference Atom_symmetry_class find_enu
+    starting point)."""
+    try:
+        e, _ = find_bound_state(r, v_sph, l, n, rel, e_lo=-30.0, e_hi=20.0)
+        return float(e)
+    except Exception:
+        return 0.15
+
+
+def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
+                       rel: str = "none") -> AtomRadialBasis:
+    r = sp.r
+    aw, enu_l = [], []
+    for l in range(lmax_apw + 1):
+        basis = sp.aw_basis(l)
+        e0 = basis[0].enu
+        if basis[0].auto:
+            n = basis[0].n if basis[0].n > 0 else l + 1
+            e0 = find_enu(r, v_sph, l, n, rel)
+        u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v_sph, l, e0, rel)
+        aw.append([
+            MtRadial(l=l, f=u, hf=e0 * u, fR=uR, fpR=upR),
+            MtRadial(l=l, f=ud, hf=e0 * ud + u, fR=udR, fpR=udpR),
+        ])
+        enu_l.append(e0)
+    lo = []
+    for d in sp.lo:
+        l = d.l
+        e0 = d.basis[0].enu
+        if d.basis[0].auto:
+            n = d.basis[0].n if d.basis[0].n > 0 else l + 1
+            e0 = find_enu(r, v_sph, l, n, rel)
+        u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v_sph, l, e0, rel)
+        c2 = 1.0
+        c1 = -udR / uR if abs(uR) > 1e-14 else 1.0
+        f = c1 * u + c2 * ud
+        hf = e0 * f + c2 * u  # (T+Vs)(c1 u + c2 ud) = E f + c2 u
+        nrm = np.sqrt(np.trapezoid(f * f * r * r, r))
+        lo.append(
+            MtRadial(
+                l=l, f=f / nrm, hf=hf / nrm,
+                fR=(c1 * uR + c2 * udR) / nrm,
+                fpR=(c1 * upR + c2 * udpR) / nrm,
+            )
+        )
+    return AtomRadialBasis(lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l)
+
+
+def sph_bessel(lmax: int, x: np.ndarray) -> np.ndarray:
+    """j_l(x) for l = 0..lmax: upward recurrence where stable (x > l),
+    downward (Miller) normalization elsewhere."""
+    x = np.asarray(x, dtype=float)
+    out = np.zeros((lmax + 1,) + x.shape)
+    small = x < 1e-8
+    xs = np.where(small, 1.0, x)
+    j0 = np.where(small, 1.0 - x * x / 6.0, np.sin(xs) / xs)
+    out[0] = j0
+    if lmax >= 1:
+        out[1] = np.where(small, x / 3.0, np.sin(xs) / xs**2 - np.cos(xs) / xs)
+    for l in range(2, lmax + 1):
+        out[l] = (2 * l - 1) / xs * out[l - 1] - out[l - 2]
+    if lmax >= 2:
+        bad = x < (lmax + 2.0)
+        if np.any(bad):
+            xb = np.where(x < 1e-8, 1e-8, x)
+            nstart = lmax + 20
+            jm = np.zeros((nstart + 2,) + x.shape)
+            jm[nstart] = 1e-30
+            for l in range(nstart - 1, -1, -1):
+                jm[l] = (2 * l + 3) / xb * jm[l + 1] - jm[l + 2]
+                # renormalize on the fly to avoid overflow of the downward
+                # recurrence for large lmax
+                big = np.abs(jm[l]) > 1e250
+                if np.any(big):
+                    s = np.where(big, 1e-250, 1.0)
+                    jm[l:] = jm[l:] * s
+            # normalize by whichever of j0/j1 is larger: j0 vanishes at
+            # x = n pi (e.g. |G| R = pi for cubic-lattice stars) and
+            # dividing by it there poisons every l of that shell
+            j1ref = out[1] if lmax >= 1 else np.where(
+                small, x / 3.0, np.sin(xs) / xs**2 - np.cos(xs) / xs
+            )
+            use0 = np.abs(j0) >= np.abs(j1ref)
+            den = np.where(
+                use0,
+                np.where(np.abs(jm[0]) > 1e-280, jm[0], 1.0),
+                np.where(np.abs(jm[1]) > 1e-280, jm[1], 1.0),
+            )
+            scale = np.where(use0, j0, j1ref) / den
+            for l in range(2, lmax + 1):
+                out[l] = np.where(bad, jm[l] * scale, out[l])
+    return out
+
+
+def sph_bessel_dx(lmax: int, x: np.ndarray) -> np.ndarray:
+    """j_l'(x): j_0' = -j_1; j_l' = j_{l-1} - (l+1)/x j_l."""
+    j = sph_bessel(lmax + 1, x)
+    out = np.zeros_like(j[: lmax + 1])
+    out[0] = -j[1]
+    xs = np.where(np.asarray(x) < 1e-8, 1.0, x)
+    for l in range(1, lmax + 1):
+        out[l] = j[l - 1] - (l + 1) / xs * j[l]
+    return out
+
+
+def matching_coefficients(gkvec_cart: np.ndarray, pos_frac: np.ndarray,
+                          millers: np.ndarray, k_frac: np.ndarray,
+                          rmt: float, basis: AtomRadialBasis, omega: float):
+    """(A, B) matching coefficients [nG, lmmax] for one atom: A multiplies
+    u_l Y_lm, B multiplies udot_l Y_lm inside the sphere."""
+    lmax = basis.lmax_apw
+    lmmax = num_lm(lmax)
+    g = np.linalg.norm(gkvec_cart, axis=1)
+    ghat = gkvec_cart / np.maximum(g, 1e-12)[:, None]
+    ghat[g < 1e-12] = np.array([0.0, 0.0, 1.0])
+    ylm = ylm_complex(lmax, ghat)  # [nG, lmmax]
+    jl = sph_bessel(lmax, g * rmt)
+    djl = sph_bessel_dx(lmax, g * rmt)
+    phase = np.exp(2j * np.pi * ((millers + k_frac) @ pos_frac))
+    pref = 4.0 * np.pi / np.sqrt(omega) * phase
+    A = np.zeros((len(g), lmmax), dtype=np.complex128)
+    B = np.zeros_like(A)
+    for l in range(lmax + 1):
+        u, ud = basis.aw[l]
+        det = u.fR * ud.fpR - u.fpR * ud.fR
+        rhs1 = jl[l]
+        rhs2 = g * djl[l]
+        a = (rhs1 * ud.fpR - rhs2 * ud.fR) / det
+        b = (rhs2 * u.fR - rhs1 * u.fpR) / det
+        il = 1j**l
+        for m in range(-l, l + 1):
+            lm = lm_index(l, m)
+            c = pref * il * np.conj(ylm[:, lm])
+            A[:, lm] = a * c
+            B[:, lm] = b * c
+    return A, B

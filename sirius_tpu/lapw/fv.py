@@ -1,0 +1,198 @@
+"""First-variational LAPW Hamiltonian/overlap assembly and diagonalization.
+
+Reference: src/hamiltonian/diagonalize_fp.hpp:29 (fv exact setup),
+set_fv_h_o in hamiltonian.hpp. Matrix structure over the basis
+[APW(G) ... | lo ...]:
+
+  O_GG' = Theta(G-G') + sum_a sum_lm A*(G) A(G') + N_l B*(G) B(G')
+  H_GG' = (1/2)(G+k).(G'+k) Theta(G-G') + (V_eff Theta)(G-G')
+          + sum_a sum_lm,l'm' [APW radial x Gaunt x V_lm integrals]
+
+with the spherical part through the (f, hf) overlap trick (basis.py) and
+the non-spherical part via hybrid Gaunt coefficients
+<Y_l1m1|R_l3m3|Y_l2m2> (the reference's SHT::gaunt_hybrid).
+
+The interstitial convolutions Theta(G-G') and (V Theta)(G-G') are read
+from FFT boxes of the fine G set."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from sirius_tpu.core.sht import _sphere_quadrature, lm_index, num_lm, ylm_complex, ylm_real
+
+
+@lru_cache(maxsize=4)
+def gaunt_hybrid(lmax1: int, lmax3: int, lmax2: int) -> np.ndarray:
+    """G[lm1, lm3, lm2] = int conj(Y_l1m1) R_l3m3 Y_l2m2 dOmega via exact
+    quadrature (complex result; reference SHT::gaunt_hybrid)."""
+    deg = lmax1 + lmax2 + lmax3 + 2
+    pts, w = _sphere_quadrature(deg)
+    y1 = ylm_complex(lmax1, pts)
+    r3 = ylm_real(lmax3, pts)
+    y2 = ylm_complex(lmax2, pts)
+    return np.einsum("pa,pb,pc,p->abc", np.conj(y1), r3, y2, w, optimize=True)
+
+
+def interstitial_tables(theta_g, veff_g, fft_index, dims):
+    """Real-space boxes of Theta and V*Theta for difference-vector lookups.
+
+    Returns (theta_box_g, vtheta_box_g): FFT boxes in G layout whose entry
+    at the FFT index of (G - G') gives the convolution coefficient."""
+    import jax.numpy as jnp
+
+    from sirius_tpu.core.fftgrid import g_to_r, r_to_g
+
+    th_r = np.asarray(g_to_r(jnp.asarray(theta_g), jnp.asarray(fft_index), dims)).real
+    v_r = np.asarray(g_to_r(jnp.asarray(veff_g), jnp.asarray(fft_index), dims)).real
+    n = dims[0] * dims[1] * dims[2]
+    th_box = np.fft.fftn(th_r) / n
+    vth_box = np.fft.fftn(v_r * th_r) / n
+    return th_box, vth_box
+
+
+def _box_lookup(box, mill_diff, dims):
+    """box values at miller-index differences [n, n, 3] -> [n, n]."""
+    i0 = np.mod(mill_diff[..., 0], dims[0])
+    i1 = np.mod(mill_diff[..., 1], dims[1])
+    i2 = np.mod(mill_diff[..., 2], dims[2])
+    return box[i0, i1, i2]
+
+
+def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
+                basis_by_atom, v_mt_lm_by_atom, theta_box, vtheta_box,
+                dims, omega):
+    """(H, O) complex Hermitian matrices over [APW(G) | lo] for one k.
+
+    gk_millers: [nG, 3] integer G of the APW set; v_mt_lm_by_atom: per
+    atom [lmmax_pot, nr] REAL-harmonic non-spherical potential (the
+    spherical lm=0 component must be EXCLUDED — it lives in the radial
+    basis through hf)."""
+    recip = 2.0 * np.pi * np.linalg.inv(lattice).T
+    gk_cart = (gk_millers + k_frac) @ recip.T
+    ng = len(gk_millers)
+    nat = len(positions)
+    # lo layout
+    lo_index = []  # (ia, ilo, l, m) -> column
+    for ia in range(nat):
+        for ilo, lof in enumerate(basis_by_atom[ia].lo):
+            for m in range(-lof.l, lof.l + 1):
+                lo_index.append((ia, ilo, lof.l, m))
+    nlo = len(lo_index)
+    ntot = ng + nlo
+    H = np.zeros((ntot, ntot), dtype=np.complex128)
+    O = np.zeros((ntot, ntot), dtype=np.complex128)
+
+    # --- interstitial (APW-APW) ---
+    md = gk_millers[:, None, :] - gk_millers[None, :, :]
+    th = _box_lookup(theta_box, md, dims)
+    vth = _box_lookup(vtheta_box, md, dims)
+    tfac = 0.5 * np.einsum("gi,hi->gh", gk_cart, gk_cart)
+    O[:ng, :ng] = th
+    H[:ng, :ng] = tfac * th + vth
+
+    from sirius_tpu.lapw.basis import matching_coefficients
+
+    for ia in range(nat):
+        b = basis_by_atom[ia]
+        r = b.r
+        lmax = b.lmax_apw
+        lmmax = num_lm(lmax)
+        A, B = matching_coefficients(
+            gk_cart, positions[ia], gk_millers, k_frac, rmt_by_atom[ia],
+            b, omega,
+        )
+        # per-l 2x2 radial overlap and spherical-H blocks
+        ov = np.zeros((lmax + 1, 2, 2))
+        hs = np.zeros((lmax + 1, 2, 2))
+        for l in range(lmax + 1):
+            for i, fi in enumerate(b.aw[l]):
+                for jj, fj in enumerate(b.aw[l]):
+                    ov[l, i, jj] = b.overlap(fi, fj)
+                    hs[l, i, jj] = b.h_sph(fi, fj)
+        l_of_lm = np.concatenate([[l] * (2 * l + 1) for l in range(lmax + 1)])
+        ovl = ov[l_of_lm]  # [lmmax, 2, 2]
+        hsl = hs[l_of_lm]
+        C = np.stack([A, B], axis=2)  # [nG, lmmax, 2]
+        O[:ng, :ng] += np.einsum(
+            "gmi,mij,hmj->gh", np.conj(C), ovl, C, optimize=True
+        )
+        H[:ng, :ng] += np.einsum(
+            "gmi,mij,hmj->gh", np.conj(C), hsl, C, optimize=True
+        )
+        # --- non-spherical MT potential (APW-APW) ---
+        v_lm = v_mt_lm_by_atom[ia]
+        if v_lm is not None and np.abs(v_lm[1:]).max() > 1e-14:
+            lmax_pot = int(np.sqrt(v_lm.shape[0])) - 1
+            gh = gaunt_hybrid(lmax, lmax_pot, lmax)  # [lm1, lm3, lm2]
+            r2 = r * r
+            # radial integrals per (lm3, l1, i, l2, j)
+            rint = np.zeros((v_lm.shape[0], lmax + 1, 2, lmax + 1, 2))
+            for lm3 in range(1, v_lm.shape[0]):  # skip spherical lm=0
+                if np.abs(v_lm[lm3]).max() < 1e-14:
+                    continue
+                for l1 in range(lmax + 1):
+                    for i, fi in enumerate(b.aw[l1]):
+                        for l2 in range(lmax + 1):
+                            for jj, fj in enumerate(b.aw[l2]):
+                                rint[lm3, l1, i, l2, jj] = np.trapezoid(
+                                    fi.f * v_lm[lm3] * fj.f * r2, r
+                                )
+            # V(lm1, i; lm2, j) = sum_lm3 gaunt[lm1, lm3, lm2] rint
+            # (explicit loops: sizes are small, clarity over cleverness)
+            Vblock = np.zeros((lmmax, 2, lmmax, 2), dtype=np.complex128)
+            for lm3 in range(1, v_lm.shape[0]):
+                if np.abs(v_lm[lm3]).max() < 1e-14:
+                    continue
+                g3 = gh[:, lm3, :]  # [lm1, lm2]
+                for lm1 in range(lmmax):
+                    l1 = int(l_of_lm[lm1])
+                    for lm2 in range(lmmax):
+                        l2 = int(l_of_lm[lm2])
+                        if abs(g3[lm1, lm2]) < 1e-14:
+                            continue
+                        Vblock[lm1, :, lm2, :] += (
+                            g3[lm1, lm2] * rint[lm3, l1, :, l2, :]
+                        )
+            H[:ng, :ng] += np.einsum(
+                "gmi,minj,hnj->gh", np.conj(C), Vblock, C, optimize=True
+            )
+        # --- lo blocks ---
+        for col, (ja, ilo, l, m) in enumerate(lo_index):
+            if ja != ia:
+                continue
+            j = ng + col
+            lof = b.lo[ilo]
+            lm = lm_index(l, m)
+            ou = b.overlap(b.aw[l][0], lof)
+            od = b.overlap(b.aw[l][1], lof)
+            hu = b.h_sph(b.aw[l][0], lof)
+            hd = b.h_sph(b.aw[l][1], lof)
+            O[:ng, j] += np.conj(A[:, lm]) * ou + np.conj(B[:, lm]) * od
+            H[:ng, j] += np.conj(A[:, lm]) * hu + np.conj(B[:, lm]) * hd
+            O[j, :ng] = np.conj(O[:ng, j])
+            H[j, :ng] = np.conj(H[:ng, j])
+            for col2, (ja2, ilo2, l2, m2) in enumerate(lo_index):
+                if ja2 != ia or l2 != l or m2 != m:
+                    continue
+                j2 = ng + col2
+                lof2 = b.lo[ilo2]
+                O[j, j2] += b.overlap(lof, lof2)
+                H[j, j2] += b.h_sph(lof, lof2)
+    H = 0.5 * (H + H.conj().T)
+    O = 0.5 * (O + O.conj().T)
+    return H, O
+
+
+def diagonalize_fv(H, O, nev: int):
+    """Lowest nev of the generalized problem via scipy-free Cholesky-or-
+    eigh regularized solve (same approach as solvers/eigen.py)."""
+    s, u = np.linalg.eigh(O)
+    good = s > 1e-9 * s.max()
+    t = u[:, good] * (1.0 / np.sqrt(s[good]))[None, :]
+    a = t.conj().T @ H @ t
+    e, c = np.linalg.eigh(a)
+    v = t @ c[:, :nev]
+    return e[:nev], v

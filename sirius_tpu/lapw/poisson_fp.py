@@ -1,0 +1,160 @@
+"""Full-potential Poisson solver: Weinert pseudocharge method.
+
+Reference: src/potential/poisson.cpp (Potential::poisson). The interstitial
+problem is solved in plane waves for a PSEUDO-density that (a) equals the
+true interstitial PW density outside the spheres and (b) carries the exact
+muffin-tin multipole moments via smooth in-sphere polynomials
+rho ~ (r/R)^l (1 - (r/R)^2)^n; the MT potential is then the interior
+solution of the true MT density (+ nucleus) with the boundary value taken
+from the interstitial solution (homogeneous r^l correction).
+
+All angular expansions use REAL harmonics R_lm; multipoles are
+q_lm = int rho(r) r^l R_lm(r-hat) d^3r; the nucleus contributes
+-Z R_00 = -Z/sqrt(4 pi) to q_00.
+"""
+
+from __future__ import annotations
+
+from math import gamma
+
+import numpy as np
+
+from sirius_tpu.core.sht import lm_index, num_lm, ylm_real
+from sirius_tpu.lapw.basis import sph_bessel
+
+Y00 = 1.0 / np.sqrt(4.0 * np.pi)
+
+
+def mt_multipoles(rho_lm: np.ndarray, r: np.ndarray) -> np.ndarray:
+    """q_lm = int rho_lm(r) r^{l+2} dr for a real-lm expansion [lmmax, nr]."""
+    lmax = int(np.sqrt(rho_lm.shape[0])) - 1
+    l_of = np.concatenate([[l] * (2 * l + 1) for l in range(lmax + 1)])
+    return np.trapezoid(rho_lm * r[None, :] ** (l_of[:, None] + 2), r, axis=1)
+
+
+def pw_sphere_multipoles(rho_g, millers, gcart, pos_frac, R, lmax):
+    """Multipoles of the PW density continued inside a sphere at pos:
+    q_lm^PW = sum_G rho(G) e^{iG.r_a} 4 pi i^l R_lm(G-hat) R^{l+2} j_{l+1}(GR)/G."""
+    glen = np.linalg.norm(gcart, axis=1)
+    ghat = np.where(glen[:, None] > 1e-12, gcart / np.maximum(glen, 1e-12)[:, None], 0.0)
+    ghat[glen < 1e-12] = [0, 0, 1]
+    rlm = ylm_real(lmax, ghat)
+    jl = sph_bessel(lmax + 1, glen * R)
+    phase = np.exp(2j * np.pi * (millers @ pos_frac))
+    lmmax = num_lm(lmax)
+    q = np.zeros(lmmax, dtype=np.complex128)
+    nz = glen > 1e-12
+    for l in range(lmax + 1):
+        rad = np.zeros_like(glen)
+        rad[nz] = R ** (l + 2) * jl[l + 1][nz] / glen[nz]
+        if l == 0:
+            rad[~nz] = R**3 / 3.0
+        c = (1j**l) * 4.0 * np.pi * rho_g * phase * rad
+        for m in range(-l, l + 1):
+            lm = lm_index(l, m)
+            q[lm] = np.sum(c * rlm[:, lm])
+    return np.real(q)
+
+
+def pseudo_density_g(rho_i_g, millers, gcart, omega, positions, rmt, dq_by_atom,
+                     lmax, nw: int | None = None):
+    """Add the Weinert smooth compensators carrying the multipole deficits
+    dq (q_MT - q_PW per atom) to the interstitial PW density."""
+    out = rho_i_g.astype(np.complex128).copy()
+    glen = np.linalg.norm(gcart, axis=1)
+    if nw is None:
+        # Weinert convergence: the compensator's spectrum peaks near
+        # GR ~ l + n + 1; keep that safely below the G cutoff
+        gmax_r = float(glen.max()) * float(np.max(rmt))
+        nw = max(2, min(14, int(gmax_r / 2) - lmax))
+    nz = glen > 1e-12
+    ghat = np.where(nz[:, None], gcart / np.maximum(glen, 1e-12)[:, None], 0.0)
+    ghat[~nz] = [0, 0, 1]
+    rlm = ylm_real(lmax, ghat)
+    fact2n = float(2.0**nw * gamma(nw + 1.0))
+    for ia in range(len(positions)):
+        R = rmt[ia]
+        dq = dq_by_atom[ia]
+        jl = sph_bessel(lmax + nw + 1, glen * R)
+        phase = np.exp(-2j * np.pi * (millers @ positions[ia]))
+        gr = glen * R
+        for l in range(lmax + 1):
+            # a_lm normalization: int x^{2l+2}(1-x^2)^n dx = B(l+3/2, n+1)/2
+            i_ln = 0.5 * gamma(l + 1.5) * gamma(nw + 1.0) / gamma(l + nw + 2.5)
+            for m in range(-l, l + 1):
+                lm = lm_index(l, m)
+                if abs(dq[lm]) < 1e-16:
+                    continue
+                a = dq[lm] / (R ** (l + 3) * i_ln)
+                radial = np.zeros_like(glen)
+                radial[nz] = (
+                    R**3 * fact2n * jl[l + nw + 1][nz] / gr[nz] ** (nw + 1)
+                )
+                if l == 0:
+                    # G=0: integral of the smooth bump = R^3 I(0,n)
+                    radial[~nz] = R**3 * i_ln
+                out += (
+                    (4.0 * np.pi / omega)
+                    * (-1j) ** l
+                    * rlm[:, lm]
+                    * a
+                    * radial
+                    * phase
+                )
+    return out
+
+
+def interstitial_potential_g(rho_pseudo_g, glen2):
+    """V(G) = 4 pi rho(G) / G^2, V(0) = 0 (charge-neutral cell)."""
+    out = np.zeros_like(rho_pseudo_g)
+    nz = glen2 > 1e-12
+    out[nz] = 4.0 * np.pi * rho_pseudo_g[nz] / glen2[nz]
+    return out
+
+
+def sphere_boundary_lm(v_g, millers, gcart, pos_frac, R, lmax):
+    """Real-lm expansion of a PW field on the sphere surface:
+    v_lm(R) = sum_G V(G) e^{iG.r_a} 4 pi i^l j_l(GR) R_lm(G-hat)."""
+    glen = np.linalg.norm(gcart, axis=1)
+    ghat = np.where(glen[:, None] > 1e-12, gcart / np.maximum(glen, 1e-12)[:, None], 0.0)
+    ghat[glen < 1e-12] = [0, 0, 1]
+    rlm = ylm_real(lmax, ghat)
+    jl = sph_bessel(lmax, glen * R)
+    phase = np.exp(2j * np.pi * (millers @ pos_frac))
+    lmmax = num_lm(lmax)
+    out = np.zeros(lmmax, dtype=np.complex128)
+    for l in range(lmax + 1):
+        c = (1j**l) * 4.0 * np.pi * v_g * phase * jl[l]
+        for m in range(-l, l + 1):
+            lm = lm_index(l, m)
+            out[lm] = np.sum(c * rlm[:, lm])
+    return np.real(out)
+
+
+def mt_coulomb_potential(rho_lm, r, zn, v_boundary_lm):
+    """Interior Coulomb potential of the MT density + nucleus with the
+    given boundary values: particular (free-space) solution per lm plus
+    the homogeneous r^l term matching v_boundary at R.
+
+    Returns (v_lm [lmmax, nr], vh_el_at_nucleus): the regular part of the
+    potential at r -> 0 (nuclear -Z/r excluded) for the enuc energy."""
+    from sirius_tpu.dft.paw import poisson_onsite
+
+    lmax = int(np.sqrt(rho_lm.shape[0])) - 1
+
+    class _T:  # poisson_onsite only touches .r and .l_by_lm3
+        pass
+
+    t = _T()
+    t.r = r
+    t.l_by_lm3 = np.concatenate([[l] * (2 * l + 1) for l in range(lmax + 1)])
+    v = poisson_onsite(t, rho_lm)
+    R = r[-1]
+    l_of = t.l_by_lm3
+    # nuclear potential in the lm=0 channel: -Z/r -> component -Z/(r Y00)
+    v[0] += -zn / (r * Y00) * 1.0
+    vR = v[:, -1]
+    v += ((v_boundary_lm - vR)[:, None]) * (r[None, :] / R) ** (l_of[:, None])
+    # regular part at nucleus: v_00(r->0) R_00 with nuclear part removed
+    v00_reg = (v[0, 0] + zn / (r[0] * Y00)) * Y00
+    return v, float(v00_reg)

@@ -1,0 +1,148 @@
+"""Full-potential (LAPW) species: muffin-tin grids, linearization recipes.
+
+Reference format (e.g. verification/test02/He.json, produced by the
+reference's apps/atoms tool; parsed in src/unit_cell/atom_type.cpp
+read_input_data): nrmt points from rmin to rmt (exponential grid), a
+free-atom density on its own grid, `valence` APW descriptors (per-l basis
+of (enu, dme, auto) linearization entries), `lo` local-orbital descriptors
+and a `core` string like '1s2 2s2' (empty = no core)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BasisEntry:
+    enu: float  # linearization energy (guess if auto)
+    dme: int  # energy-derivative order (0 = u, 1 = udot)
+    auto: int  # 0 = fixed enu, 1+ = search enu from band structure
+    n: int = 0  # principal quantum number (for auto search)
+
+
+@dataclasses.dataclass
+class LoDescriptor:
+    l: int
+    basis: list  # [BasisEntry]
+
+
+@dataclasses.dataclass
+class FpSpecies:
+    label: str
+    symbol: str
+    zn: int
+    mass: float
+    rmt: float
+    nrmt: int
+    rmin: float
+    rinf: float
+    r: np.ndarray  # muffin-tin exponential grid [nrmt], r[-1] = rmt
+    free_atom_r: np.ndarray
+    free_atom_density: np.ndarray
+    aw_default: list  # default APW basis (l not covered by aw_specific)
+    aw_specific: dict  # l -> [BasisEntry]
+    lo: list  # [LoDescriptor]
+    core: str  # e.g. "1s2 2s2"
+
+    @staticmethod
+    def from_file(label: str, path: str) -> "FpSpecies":
+        with open(path) as f:
+            d = json.load(f)
+        nrmt = int(d["nrmt"])
+        rmin, rmt = float(d["rmin"]), float(d["rmt"])
+        # exponential grid like the reference default (atom_type.cpp
+        # init radial grid): r_i = rmin (rmt/rmin)^{i/(n-1)}
+        r = rmin * (rmt / rmin) ** (np.arange(nrmt) / (nrmt - 1.0))
+        aw_default, aw_specific = [], {}
+        for v in d.get("valence", []):
+            basis = [
+                BasisEntry(
+                    enu=float(b.get("enu", 0.15)),
+                    dme=int(b.get("dme", 0)),
+                    auto=int(b.get("auto", 0)),
+                    n=int(b.get("n", 0)),
+                )
+                for b in v["basis"]
+            ]
+            if "l" in v:
+                aw_specific[int(v["l"])] = basis
+            else:
+                aw_default = basis
+        lo = [
+            LoDescriptor(
+                l=int(e["l"]),
+                basis=[
+                    BasisEntry(
+                        enu=float(b.get("enu", 0.15)),
+                        dme=int(b.get("dme", 0)),
+                        auto=int(b.get("auto", 0)),
+                        n=int(b.get("n", 0)),
+                    )
+                    for b in e["basis"]
+                ],
+            )
+            for e in d.get("lo", [])
+        ]
+        return FpSpecies(
+            label=label,
+            symbol=d.get("symbol", label),
+            zn=int(d["number"]),
+            mass=float(d.get("mass", 0.0)),
+            rmt=rmt,
+            nrmt=nrmt,
+            rmin=rmin,
+            rinf=float(d.get("rinf", 50.0)),
+            r=r,
+            free_atom_r=np.asarray(d["free_atom"]["radial_grid"], float),
+            free_atom_density=np.asarray(d["free_atom"]["density"], float),
+            aw_default=aw_default,
+            aw_specific=aw_specific,
+            lo=lo,
+            core=d.get("core", ""),
+        )
+
+    def aw_basis(self, l: int) -> list:
+        return self.aw_specific.get(l, self.aw_default)
+
+    def core_states(self) -> list:
+        """[(n, l, occupancy)] parsed from the core string '1s2 2s2 2p6'."""
+        out = []
+        lmap = {"s": 0, "p": 1, "d": 2, "f": 3}
+        for tok in self.core.split():
+            n = int(tok[0])
+            l = lmap[tok[1]]
+            occ = float(tok[2:]) if len(tok) > 2 else 2.0 * (2 * l + 1)
+            out.append((n, l, occ))
+        return out
+
+
+def step_function_g(lattice: np.ndarray, positions: np.ndarray,
+                    rmt: np.ndarray, gcart: np.ndarray,
+                    millers: np.ndarray) -> np.ndarray:
+    """PW coefficients of the unit-step (characteristic) function
+    Theta(r) = 1 in the interstitial, 0 inside any muffin-tin sphere
+    (reference src/unit_cell/unit_cell.cpp generate step function):
+
+      Theta(G) = delta_{G,0} - sum_a e^{-i G r_a} (4 pi / Omega G^3)
+                 (sin(G R_a) - G R_a cos(G R_a)).
+    """
+    omega = abs(np.linalg.det(lattice))
+    glen = np.linalg.norm(gcart, axis=1)
+    out = np.zeros(len(gcart), dtype=np.complex128)
+    out[glen < 1e-12] = 1.0
+    for ia in range(len(positions)):
+        R = rmt[ia]
+        gr = glen * R
+        w = np.empty_like(glen)
+        small = glen < 1e-12
+        w[~small] = (
+            4.0 * np.pi / (omega * glen[~small] ** 3)
+            * (np.sin(gr[~small]) - gr[~small] * np.cos(gr[~small]))
+        )
+        w[small] = 4.0 * np.pi * R**3 / (3.0 * omega)
+        phase = np.exp(-2j * np.pi * (millers @ positions[ia]))
+        out -= w * phase
+    return out
