@@ -28,9 +28,12 @@ import dataclasses
 
 import numpy as np
 
+from sirius_tpu.lapw.quad import rint
+
 from sirius_tpu.core.sht import lm_index, num_lm, ylm_complex
 from sirius_tpu.lapw.radial_solver import (
     find_bound_state,
+    find_enu_band,
     radial_solution_with_edot,
 )
 
@@ -60,7 +63,7 @@ class AtomRadialBasis:
     enu: list
 
     def overlap(self, f1: MtRadial, f2: MtRadial) -> float:
-        return float(np.trapezoid(f1.f * f2.f * self.r**2, self.r))
+        return float(rint(f1.f * f2.f * self.r**2, self.r))
 
     def h_sph(self, f1: MtRadial, f2: MtRadial) -> float:
         """Symmetrized spherical-Hamiltonian integral INCLUDING the kinetic
@@ -72,8 +75,8 @@ class AtomRadialBasis:
         hamiltonian.hpp — the a^* b u u' boundary term)."""
         r2 = self.r**2
         vol = 0.5 * float(
-            np.trapezoid(f1.f * f2.hf * r2, self.r)
-            + np.trapezoid(f1.hf * f2.f * r2, self.r)
+            rint(f1.f * f2.hf * r2, self.r)
+            + rint(f1.hf * f2.f * r2, self.r)
         )
         R = self.r[-1]
         surf = 0.25 * R * R * (f1.fR * f2.fpR + f1.fpR * f2.fR)
@@ -81,11 +84,11 @@ class AtomRadialBasis:
 
 
 def find_enu(r, v_sph, l: int, n: int, rel: str = "none") -> float:
-    """Linearization energy: bound-state energy of the spherical potential
-    at principal quantum number n (reference Atom_symmetry_class find_enu
-    starting point)."""
+    """Linearization energy: band center (ebot + etop)/2 of the (n, l)
+    muffin-tin band (reference Enu_finder, radial_solver.hpp:1172,
+    auto_enu = 1)."""
     try:
-        e, _ = find_bound_state(r, v_sph, l, n, rel, e_lo=-30.0, e_hi=20.0)
+        e, _, _ = find_enu_band(r, v_sph, l, n, rel)
         return float(e)
     except Exception:
         return 0.15
@@ -115,11 +118,15 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
             n = d.basis[0].n if d.basis[0].n > 0 else l + 1
             e0 = find_enu(r, v_sph, l, n, rel)
         u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v_sph, l, e0, rel)
-        c2 = 1.0
-        c1 = -udR / uR if abs(uR) > 1e-14 else 1.0
+        # zero-boundary combination WITHOUT division: (c1, c2) = (udR, -uR)
+        # gives f(R) = 0 exactly and stays stable when the auto enu lands on
+        # a bound state with u(R) -> 0 (then f ~ udR * u, pure u — correct)
+        c1, c2 = udR, -uR
+        if abs(c1) + abs(c2) < 1e-14:
+            c1, c2 = 1.0, 0.0
         f = c1 * u + c2 * ud
         hf = e0 * f + c2 * u  # (T+Vs)(c1 u + c2 ud) = E f + c2 u
-        nrm = np.sqrt(np.trapezoid(f * f * r * r, r))
+        nrm = np.sqrt(rint(f * f * r * r, r))
         lo.append(
             MtRadial(
                 l=l, f=f / nrm, hf=hf / nrm,

@@ -1,0 +1,163 @@
+"""Full-potential density generation: muffin-tin + interstitial parts.
+
+Reference: src/density/density.cpp (generate_valence + add_k_point_contribution_dm
+for the MT density matrices, generate_rho_aug-free FP branch for the
+interstitial), src/unit_cell/atom_symmetry_class.cpp for the radial-function
+pair products.
+
+MT density: inside sphere a the wave function is
+  psi(r) = sum_{lm,i} W_{lm,i} f_i(r) Y_lm(r-hat),
+with W from the APW matching coefficients (A, B) contracted against the
+plane-wave eigenvector plus the explicit lo columns. The real-harmonic
+density components are
+  rho_{lm3}(r) = sum_{(lm1,i),(lm2,j)} D[(lm1,i),(lm2,j)]
+                 <Y_lm1|R_lm3|Y_lm2> f_i(r) f_j(r),
+  D = sum_{k,b} w_k occ_b conj(W_1) W_2.
+
+Interstitial density: FFT of the APW plane-wave part over the fine grid,
+rho_i(r) = sum_kb w occ |psi_PW(r)|^2 (valid in the interstitial; inside
+spheres it is overridden by the MT expansion).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sirius_tpu.lapw.quad import rint
+
+from sirius_tpu.core.sht import lm_index, num_lm
+from sirius_tpu.lapw.fv import gaunt_hybrid
+
+
+def mt_index(basis, lmax_apw: int):
+    """Flat MT expansion index for one atom.
+
+    Returns (rf, lm_of, rf_of) where rf is the list of radial-function
+    arrays [nrf][nr], lm_of[nidx] the lm of each expansion entry and
+    rf_of[nidx] its radial-function index. Ordering matches the fv
+    eigenvector layout: APW (u, udot) per lm first, then the atom's lo
+    entries in fv.assemble_fv's lo_index order."""
+    rf = []
+    rf_l = []
+    for l in range(lmax_apw + 1):
+        for f in basis.aw[l]:
+            rf.append(f.f)
+            rf_l.append(l)
+    lo_rf0 = len(rf)
+    for f in basis.lo:
+        rf.append(f.f)
+        rf_l.append(f.l)
+    lm_of, rf_of = [], []
+    for l in range(lmax_apw + 1):
+        for m in range(-l, l + 1):
+            lm = lm_index(l, m)
+            lm_of += [lm, lm]
+            rf_of += [2 * l, 2 * l + 1]
+    for ilo, f in enumerate(basis.lo):
+        for m in range(-f.l, f.l + 1):
+            lm_of.append(lm_index(f.l, m))
+            rf_of.append(lo_rf0 + ilo)
+    return rf, np.asarray(lm_of), np.asarray(rf_of)
+
+
+def mt_expansion_coeffs(C, A, B, lo_cols, basis, lmax_apw: int):
+    """W[nidx, nev]: MT expansion coefficients of the fv eigenvectors.
+
+    C: [ng+nlo_total, nev] eigenvectors; A, B: [ng, lmmax] matching
+    coefficients of this atom; lo_cols: list of eigenvector rows for this
+    atom's lo entries in (ilo, m) order."""
+    ng = A.shape[0]
+    lmmax = num_lm(lmax_apw)
+    nev = C.shape[1]
+    wa = A.T @ C[:ng]  # [lmmax, nev]
+    wb = B.T @ C[:ng]
+    # interleave (u, udot) per lm
+    w_apw = np.empty((2 * lmmax, nev), dtype=np.complex128)
+    w_apw[0::2] = wa
+    w_apw[1::2] = wb
+    if lo_cols:
+        w_lo = C[np.asarray(lo_cols)]
+        return np.concatenate([w_apw, w_lo], axis=0)
+    return w_apw
+
+
+def atom_lo_cols(lo_index, ia: int, ng: int):
+    """Eigenvector rows of atom ia's local orbitals, in fv column order."""
+    return [ng + col for col, (ja, _, _, _) in enumerate(lo_index) if ja == ia]
+
+
+def mt_density_from_dm(D, lm_of, rf_of, rf, lmax_rho: int, lmax_apw: int):
+    """rho_lm[lmmax_rho, nr] (real harmonics) from the MT density matrix.
+
+    D: [nidx, nidx] hermitian; gaunt G[lm1, lm3, lm2] = <Y1|R3|Y2>."""
+    gh = gaunt_hybrid(lmax_apw, lmax_rho, lmax_apw)  # [lm1, lm3, lm2]
+    nrf = len(rf)
+    lmmax_rho = num_lm(lmax_rho)
+    # T[rf1, rf2, lm3] = sum over entries with those radial functions
+    gg = gh[lm_of[:, None], :, lm_of[None, :]]  # [nidx, nidx, lm3]
+    x = D[:, :, None] * gg
+    T = np.zeros((nrf, nrf, lmmax_rho), dtype=np.complex128)
+    np.add.at(T, (rf_of[:, None], rf_of[None, :]), x)
+    F = np.stack(rf)  # [nrf, nr]
+    rho = np.einsum("abL,ar,br->Lr", T, F, F, optimize=True)
+    return np.ascontiguousarray(rho.real)
+
+
+def interstitial_density_box(C_k_list, gkmill_list, occ, kweights, dims, omega):
+    """rho(r) on the fine FFT grid from the APW plane-wave parts.
+
+    C_k_list[ik]: [ng_k + nlo, nev]; gkmill_list[ik]: [ng_k, 3];
+    occ: [nk, nev] (already includes max_occupancy); kweights: [nk]."""
+    n = dims[0] * dims[1] * dims[2]
+    rho_r = np.zeros(dims)
+    for ik, (C, mill) in enumerate(zip(C_k_list, gkmill_list)):
+        ng = len(mill)
+        i0 = np.mod(mill[:, 0], dims[0])
+        i1 = np.mod(mill[:, 1], dims[1])
+        i2 = np.mod(mill[:, 2], dims[2])
+        for ib in range(C.shape[1]):
+            f = kweights[ik] * occ[ik, ib]
+            if f < 1e-12:
+                continue
+            box = np.zeros(dims, dtype=np.complex128)
+            box[i0, i1, i2] = C[:ng, ib]
+            psi = np.fft.ifftn(box) * n / np.sqrt(omega)
+            rho_r += f * np.abs(psi) ** 2
+    return rho_r
+
+
+def free_atom_rho_mt(sp, lmax_rho: int) -> np.ndarray:
+    """Initial MT density: the species' free-atom density interpolated on
+    the MT grid, in the lm=0 real-harmonic channel."""
+    lmmax = num_lm(lmax_rho)
+    rho = np.zeros((lmmax, sp.nrmt))
+    rho_sph = np.interp(sp.r, sp.free_atom_r, sp.free_atom_density)
+    rho[0] = rho_sph * np.sqrt(4.0 * np.pi)
+    return rho
+
+
+def free_atom_rho_g(species_by_atom, positions, millers, gcart, omega):
+    """Superposition of free-atom densities in plane waves over the fine
+    G set: rho(G) = (1/Omega) sum_a e^{-i G r_a} 4 pi
+    int rho_a(r) j0(Gr) r^2 dr (reference density.cpp initial density)."""
+    glen = np.linalg.norm(gcart, axis=1)
+    shells, inv = np.unique(np.round(glen, 10), return_inverse=True)
+    out = np.zeros(len(gcart), dtype=np.complex128)
+    cache = {}
+    for ia, sp in enumerate(species_by_atom):
+        key = id(sp)
+        if key not in cache:
+            r = sp.free_atom_r
+            rho = sp.free_atom_density
+            ff = np.empty(len(shells))
+            for i, g in enumerate(shells):
+                if g < 1e-12:
+                    ff[i] = 4.0 * np.pi * rint(rho * r * r, r)
+                else:
+                    ff[i] = 4.0 * np.pi * rint(
+                        rho * np.sinc(g * r / np.pi) * r * r, r
+                    )
+            cache[key] = ff
+        phase = np.exp(-2j * np.pi * (millers @ positions[ia]))
+        out += cache[key][inv] * phase / omega
+    return out

@@ -122,43 +122,36 @@ def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
         H[:ng, :ng] += np.einsum(
             "gmi,mij,hmj->gh", np.conj(C), hsl, C, optimize=True
         )
-        # --- non-spherical MT potential (APW-APW) ---
+        # --- non-spherical MT potential over the FULL MT index (APW + lo):
+        # the generic sandwich conj(W) V W^T with W mapping basis columns to
+        # MT expansion entries — lo rows/columns get the same V_nonsph
+        # coupling as the APW block (reference set_fv_h_o lo contributions)
         v_lm = v_mt_lm_by_atom[ia]
         if v_lm is not None and np.abs(v_lm[1:]).max() > 1e-14:
+            from sirius_tpu.lapw.density_fp import mt_index
+            from sirius_tpu.lapw.quad import radial_weights
+
             lmax_pot = int(np.sqrt(v_lm.shape[0])) - 1
             gh = gaunt_hybrid(lmax, lmax_pot, lmax)  # [lm1, lm3, lm2]
-            r2 = r * r
-            # radial integrals per (lm3, l1, i, l2, j)
-            rint = np.zeros((v_lm.shape[0], lmax + 1, 2, lmax + 1, 2))
-            for lm3 in range(1, v_lm.shape[0]):  # skip spherical lm=0
-                if np.abs(v_lm[lm3]).max() < 1e-14:
-                    continue
-                for l1 in range(lmax + 1):
-                    for i, fi in enumerate(b.aw[l1]):
-                        for l2 in range(lmax + 1):
-                            for jj, fj in enumerate(b.aw[l2]):
-                                rint[lm3, l1, i, l2, jj] = np.trapezoid(
-                                    fi.f * v_lm[lm3] * fj.f * r2, r
-                                )
-            # V(lm1, i; lm2, j) = sum_lm3 gaunt[lm1, lm3, lm2] rint
-            # (explicit loops: sizes are small, clarity over cleverness)
-            Vblock = np.zeros((lmmax, 2, lmmax, 2), dtype=np.complex128)
-            for lm3 in range(1, v_lm.shape[0]):
-                if np.abs(v_lm[lm3]).max() < 1e-14:
-                    continue
-                g3 = gh[:, lm3, :]  # [lm1, lm2]
-                for lm1 in range(lmmax):
-                    l1 = int(l_of_lm[lm1])
-                    for lm2 in range(lmmax):
-                        l2 = int(l_of_lm[lm2])
-                        if abs(g3[lm1, lm2]) < 1e-14:
-                            continue
-                        Vblock[lm1, :, lm2, :] += (
-                            g3[lm1, lm2] * rint[lm3, l1, :, l2, :]
-                        )
-            H[:ng, :ng] += np.einsum(
-                "gmi,minj,hnj->gh", np.conj(C), Vblock, C, optimize=True
+            rf, lm_of, rf_of = mt_index(b, lmax)
+            nidx = len(lm_of)
+            wr2 = radial_weights(r) * r * r
+            F = np.stack(rf)  # [nrf, nr]
+            RI = np.einsum("ax,Lx,bx,x->abL", F, v_lm, F, wr2, optimize=True)
+            RI[:, :, 0] = 0.0  # spherical part lives in h_sph already
+            GG = gh[lm_of[:, None], :, lm_of[None, :]]  # [p, q, lm3]
+            V = np.einsum(
+                "pqL,pqL->pq", GG, RI[rf_of[:, None], rf_of[None, :], :]
             )
+            W = np.zeros((ntot, nidx), dtype=np.complex128)
+            W[:ng, 0 : 2 * lmmax : 2] = A
+            W[:ng, 1 : 2 * lmmax : 2] = B
+            kk = 2 * lmmax
+            for col, (ja, _, _, _) in enumerate(lo_index):
+                if ja == ia:
+                    W[ng + col, kk] = 1.0
+                    kk += 1
+            H += np.einsum("xp,pq,yq->xy", np.conj(W), V, W, optimize=True)
         # --- lo blocks ---
         for col, (ja, ilo, l, m) in enumerate(lo_index):
             if ja != ia:

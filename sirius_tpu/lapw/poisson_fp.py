@@ -19,6 +19,8 @@ from math import gamma
 
 import numpy as np
 
+from sirius_tpu.lapw.quad import rint
+
 from sirius_tpu.core.sht import lm_index, num_lm, ylm_real
 from sirius_tpu.lapw.basis import sph_bessel
 
@@ -29,7 +31,7 @@ def mt_multipoles(rho_lm: np.ndarray, r: np.ndarray) -> np.ndarray:
     """q_lm = int rho_lm(r) r^{l+2} dr for a real-lm expansion [lmmax, nr]."""
     lmax = int(np.sqrt(rho_lm.shape[0])) - 1
     l_of = np.concatenate([[l] * (2 * l + 1) for l in range(lmax + 1)])
-    return np.trapezoid(rho_lm * r[None, :] ** (l_of[:, None] + 2), r, axis=1)
+    return rint(rho_lm * r[None, :] ** (l_of[:, None] + 2), r)
 
 
 def pw_sphere_multipoles(rho_g, millers, gcart, pos_frac, R, lmax):
@@ -63,10 +65,11 @@ def pseudo_density_g(rho_i_g, millers, gcart, omega, positions, rmt, dq_by_atom,
     out = rho_i_g.astype(np.complex128).copy()
     glen = np.linalg.norm(gcart, axis=1)
     if nw is None:
-        # Weinert convergence: the compensator's spectrum peaks near
-        # GR ~ l + n + 1; keep that safely below the G cutoff
+        # reference pseudo_density_order_ = 9 (potential.hpp:79), clamped so
+        # the compensator's spectral peak (GR ~ l + n + 1) stays inside the
+        # represented G set on low-cutoff decks
         gmax_r = float(glen.max()) * float(np.max(rmt))
-        nw = max(2, min(14, int(gmax_r / 2) - lmax))
+        nw = max(2, min(9, int(gmax_r / 2) - lmax))
     nz = glen > 1e-12
     ghat = np.where(nz[:, None], gcart / np.maximum(glen, 1e-12)[:, None], 0.0)
     ghat[~nz] = [0, 0, 1]

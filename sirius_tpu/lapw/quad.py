@@ -1,0 +1,60 @@
+"""Radial quadrature weights for muffin-tin grids.
+
+The reference integrates radial functions with C^3 splines
+(src/core/radial_grid + Spline::integrate); trapezoid on the same grids
+loses ~1e-5 relative accuracy — visible at the 1e-5 Ha verification bar.
+For the (exactly geometric) MT grids used here, substituting x = ln r maps
+the grid to uniform spacing, where composite Simpson (+ a 3/8 tail when the
+interval count is odd) gives O(h^4) accuracy: int f dr = int f(r(x)) r dx.
+Non-geometric grids (free-atom grids from species files) fall back to
+trapezoid weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _uniform_composite(n: int) -> np.ndarray:
+    """Weights for int over n uniformly spaced points, unit spacing."""
+    if n < 2:
+        return np.zeros(n)
+    if n == 2:
+        return np.array([0.5, 0.5])
+    if n == 3:
+        return np.array([1.0, 4.0, 1.0]) / 3.0
+    w = np.zeros(n)
+    nint = n - 1
+    if nint % 2 == 0:
+        w[0] = w[-1] = 1.0 / 3.0
+        w[1:-1:2] = 4.0 / 3.0
+        w[2:-2:2] = 2.0 / 3.0
+    else:
+        m = n - 3  # Simpson over first m points (m-1 intervals, even)
+        ws = _uniform_composite(m)
+        w[:m] += ws
+        w[m - 1 :] += np.array([3.0, 9.0, 9.0, 3.0]) / 8.0
+    return w
+
+
+def radial_weights(r: np.ndarray) -> np.ndarray:
+    """w such that int f dr ~= w . f on this grid."""
+    r = np.asarray(r, float)
+    n = len(r)
+    if n < 2:
+        return np.zeros(n)
+    ratio = r[1:] / r[:-1]
+    if r[0] > 0 and np.allclose(ratio, ratio[0], rtol=1e-9, atol=0):
+        h = float(np.log(ratio[0]))
+        return _uniform_composite(n) * h * r
+    # fallback: trapezoid
+    w = np.zeros(n)
+    d = np.diff(r)
+    w[:-1] += 0.5 * d
+    w[1:] += 0.5 * d
+    return w
+
+
+def rint(f: np.ndarray, r: np.ndarray) -> float | np.ndarray:
+    """int f dr along the LAST axis with spline-grade weights."""
+    return np.asarray(f) @ radial_weights(r)

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from sirius_tpu.lapw.quad import rint
+
 SPEED_OF_LIGHT = 137.035999139
 ALPHA = 1.0 / SPEED_OF_LIGHT
 SQ_ALPHA_HALF = 0.5 * ALPHA * ALPHA
@@ -152,9 +154,61 @@ def find_bound_state(r, veff, l: int, n: int, rel: str = "none",
             break
     E = 0.5 * (lo + hi)
     p, _, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
+    # outward integration amplifies the e^{+kappa r} junk solution beyond
+    # the classical turning point; cut the tail at its |p| minimum after
+    # the peak (it should be ~0 for a converged bound state)
+    ipk = int(np.argmax(np.abs(p)))
+    icut = ipk + int(np.argmin(np.abs(p[ipk:])))
+    if icut < len(p) - 1 and abs(p[icut]) < 1e-6 * abs(p[ipk]):
+        p = p.copy()
+        p[icut:] = 0.0
     u = p / r
-    nrm = np.sqrt(np.trapezoid(p * p, r))
+    nrm = np.sqrt(rint(p * p, r))
     return E, u / nrm
+
+
+def find_enu_band(r, veff, l: int, n: int, rel: str = "none"):
+    """Linearization energy as the CENTER of the (n, l) band:
+    (ebot + etop)/2 with etop the energy where u(R) = 0 at node count
+    n - l - 1 and ebot where p'(R) = 0 (reference Enu_finder::find_enu,
+    radial_solver.hpp:1172-1276, auto_enu = 1)."""
+    etop, _ = find_bound_state(r, veff, l, n, rel)
+    v2 = _with_midpoints(r, veff)
+    R = r[-1]
+
+    def pderiv(E):
+        p, q, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
+        m = float(_mass(rel, E, np.asarray([veff[-1]]))[0])
+        return 2.0 * m * q[-1] + p[-1] / R
+
+    sd = pderiv(etop)
+    denu = 1e-8
+    e0 = etop
+    bracketed = False
+    for _ in range(60):
+        if pderiv(e0) * sd <= 0:
+            bracketed = True
+            break
+        if denu > 20:
+            break
+        denu *= 2
+        e0 -= denu
+    if not bracketed:
+        # no p'(R) sign change within ~40 Ha below the band top: the band
+        # has no well-defined bottom here — fall back to the top
+        return etop, etop, etop
+    e1, e2 = e0, e0 + denu
+    for _ in range(80):
+        mid = 0.5 * (e1 + e2)
+        d = pderiv(mid)
+        if d * sd > 0:
+            e2 = mid
+        else:
+            e1 = mid
+        if abs(d) < 1e-8 or (e2 - e1) < 1e-12:
+            break
+    ebot = 0.5 * (e1 + e2)
+    return 0.5 * (ebot + etop), ebot, etop
 
 
 def find_bound_state_dirac(r, veff, n: int, kappa: int,
@@ -228,7 +282,7 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
             break
     E = 0.5 * (lo + hi)
     P, Q, _ = integrate(E)
-    nrm = np.sqrt(np.trapezoid(P * P + Q * Q, r))
+    nrm = np.sqrt(rint(P * P + Q * Q, r))
     return E, (P / nrm) / r, (Q / nrm) / r
 
 
@@ -238,13 +292,13 @@ def radial_solution_with_edot(r, veff, l: int, E: float, rel: str = "none"):
     orthogonalized against u (reference Radial_solver::solve m=1 +
     Atom_symmetry_class orthogonalization)."""
     p, q, _ = integrate_outward(r, veff, l, E, rel)
-    nrm = np.sqrt(np.trapezoid(p * p, r))
+    nrm = np.sqrt(rint(p * p, r))
     p, q = p / nrm, q / nrm
     pd, qd, _ = integrate_outward(
         r, veff, l, E, rel,
         p_prev=_with_midpoints(r, p), q_prev=_with_midpoints(r, q), mderiv=1,
     )
-    ov = np.trapezoid(p * pd, r)
+    ov = rint(p * pd, r)
     pd = pd - ov * p
     qd = qd - ov * q
     R = r[-1]

@@ -1,0 +1,480 @@
+"""Full-potential LAPW self-consistency driver.
+
+Reference: src/dft/dft_ground_state.cpp specialized to
+electronic_structure_method = full_potential_lapwlo — the FP branch of
+Density::generate, Potential::generate (Weinert Poisson + MT XC) and
+Band::solve (diagonalize_fp). Total-energy bookkeeping follows
+src/dft/energy.cpp:
+
+  veff  = int rho v_eff        (MT lm sums + step-function interstitial)
+  vha   = int rho v_H          (v_H includes the nuclear Coulomb)
+  kin   = eval_sum - veff
+  enuc  = -(1/2) sum_a Z_a v_el(r_a)   (regular Hartree at the nucleus)
+  total = kin + exc + (1/2) vha + enuc
+
+The SCF state mixed between iterations is the packed density
+[rho_i(G) | rho_mt per atom]; plain l2 metric.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from sirius_tpu.lapw.quad import rint
+
+from sirius_tpu.config.schema import load_config
+from sirius_tpu.core.fftgrid import FFTGrid
+from sirius_tpu.core.gvec import Gvec, _enumerate_sphere, reciprocal_lattice
+from sirius_tpu.core.sht import num_lm
+from sirius_tpu.crystal.symmetry import CrystalSymmetry
+from sirius_tpu.crystal.kpoints import irreducible_kmesh
+from sirius_tpu.dft.mixer import Mixer
+from sirius_tpu.dft.occupation import find_fermi
+from sirius_tpu.dft.xc import XCFunctional
+from sirius_tpu.lapw.basis import build_radial_basis, matching_coefficients
+from sirius_tpu.lapw.density_fp import (
+    atom_lo_cols,
+    free_atom_rho_g,
+    free_atom_rho_mt,
+    interstitial_density_box,
+    mt_density_from_dm,
+    mt_expansion_coeffs,
+    mt_index,
+)
+from sirius_tpu.lapw.fv import assemble_fv, diagonalize_fv
+from sirius_tpu.lapw.poisson_fp import (
+    mt_coulomb_potential,
+    mt_multipoles,
+    pseudo_density_g,
+    pw_sphere_multipoles,
+    sphere_boundary_lm,
+    interstitial_potential_g,
+)
+from sirius_tpu.lapw.species import FpSpecies, step_function_g
+from sirius_tpu.lapw.xc_fp import MtSht, interstitial_xc, mt_xc
+
+Y00 = 1.0 / np.sqrt(4.0 * np.pi)
+
+
+class FpContext:
+    """Composition root for a full-potential run (FP analog of
+    SimulationContext; reference Simulation_context FP branches)."""
+
+    def __init__(self, cfg, base_dir: str = "."):
+        import os
+
+        p = cfg.parameters
+        uc = cfg.unit_cell
+        self.cfg = cfg
+        a = np.asarray(uc.lattice_vectors, float) * uc.lattice_vectors_scale
+        self.lattice = a
+        self.omega = float(abs(np.linalg.det(a)))
+        self.recip = reciprocal_lattice(a)
+
+        self.species = {}
+        for label in uc.atom_types:
+            fname = uc.atom_files.get(label, f"{label}.json")
+            self.species[label] = FpSpecies.from_file(
+                label, os.path.join(base_dir, fname)
+            )
+        self.labels = []
+        pos = []
+        for label in uc.atom_types:
+            for v in uc.atoms.get(label, []):
+                pos.append(np.asarray(v[:3], float))
+                self.labels.append(label)
+        self.positions = np.asarray(pos)
+        self.species_of_atom = [self.species[l] for l in self.labels]
+        self.rmt = np.asarray([sp.rmt for sp in self.species_of_atom])
+        self.zn_tot = sum(sp.zn for sp in self.species_of_atom)
+
+        self.lmax_apw = p.lmax_apw
+        self.lmax_rho = p.lmax_rho
+        self.lmax_pot = p.lmax_pot
+        self.gk_cutoff = (
+            p.gk_cutoff if p.gk_cutoff > 0 else p.aw_cutoff / self.rmt.min()
+        )
+
+        # fine (density/potential) G set — box holds the pw_cutoff sphere
+        fft = FFTGrid.for_cutoff(a, p.pw_cutoff)
+        self.gvec = Gvec.build(a, p.pw_cutoff, fft=fft)
+        self.dims = fft.dims
+        self.theta_g = step_function_g(
+            a, self.positions, self.rmt, self.gvec.gcart, self.gvec.millers
+        )
+        n = np.prod(self.dims)
+        box = np.zeros(self.dims, dtype=np.complex128).ravel()
+        box[self.gvec.fft_index] = self.theta_g
+        self.theta_r = np.real(np.fft.ifftn(box.reshape(self.dims)) * n)
+
+        # k-mesh
+        self.sym = CrystalSymmetry.find(
+            a, self.positions, np.asarray([hash(l) for l in self.labels])
+        ) if p.use_symmetry else None
+        self.kpoints, self.kweights = irreducible_kmesh(
+            p.ngridk, p.shiftk, self.sym, use_symmetry=p.use_symmetry
+        )
+        # APW |G+k| spheres (ragged; host assembly)
+        self.gkmill = [
+            _enumerate_sphere(self.recip, np.asarray(k), self.gk_cutoff, fft)
+            for k in self.kpoints
+        ]
+
+        self.num_fv_states = (
+            p.num_fv_states
+            if p.num_fv_states > 0
+            else max(int(self.zn_tot / 2) + 10, 4)
+        )
+        # core electrons per atom from the species' core string
+        self.core_occ = [
+            sum(occ for (_, _, occ) in sp.core_states())
+            for sp in self.species_of_atom
+        ]
+        self.num_valence = self.zn_tot - sum(self.core_occ) + (
+            -p.extra_charge if hasattr(p, "extra_charge") else 0.0
+        )
+        self.sht = MtSht(self.lmax_rho, self.lmax_pot)
+        self.xc = XCFunctional(p.xc_functionals)
+
+    def mt_integral(self, f_lm_by_atom, g_lm_by_atom) -> float:
+        """sum_a sum_lm int f_lm g_lm r^2 dr (real-harmonic orthonormality)."""
+        out = 0.0
+        for sp, f, g in zip(self.species_of_atom, f_lm_by_atom, g_lm_by_atom):
+            nlm = min(f.shape[0], g.shape[0])
+            out += float(
+                rint(
+                    np.sum(f[:nlm] * g[:nlm], axis=0) * sp.r**2, sp.r
+                )
+            )
+        return out
+
+    def istl_integral(self, f_r, g_r) -> float:
+        """(Omega/N) sum_r f g theta — interstitial region integral."""
+        n = np.prod(self.dims)
+        return float(self.omega / n * np.sum(f_r * g_r * self.theta_r))
+
+
+def core_states_density(sp, v_sph, rel: str = "dirac"):
+    """Core density [nr] (per volume, spherical) + eigenvalue sum + charge
+    leak outside the sphere. Solved on the MT grid extended by the
+    free-atom tail potential -Z_ion/r (reference atom_symmetry_class
+    generate_core_charge_density on the free-atom grid)."""
+    from sirius_tpu.lapw.radial_solver import (
+        find_bound_state,
+        find_bound_state_dirac,
+    )
+
+    if not sp.core_states():
+        return np.zeros_like(sp.r), 0.0, 0.0
+    e_floor = -0.6 * sp.zn**2 - 10.0  # brackets 1s for any Z
+    # extended grid: MT grid + exponential continuation to rinf
+    r_mt = sp.r
+    r_ext = np.geomspace(r_mt[-1], max(sp.rinf, r_mt[-1] * 2), 400)[1:]
+    r = np.concatenate([r_mt, r_ext])
+    v = np.concatenate([v_sph, v_sph[-1] * r_mt[-1] / r_ext])
+    rho = np.zeros_like(r)
+    esum = 0.0
+    for (nql, l, occ) in sp.core_states():
+        if rel == "dirac":
+            # both j = l +- 1/2 branches, degeneracy-weighted
+            etot, utot = 0.0, np.zeros_like(r)
+            for kappa in ([-1] if l == 0 else [l, -l - 1]):
+                deg = 2 * abs(kappa)
+                e, g, f = find_bound_state_dirac(r, v, nql, kappa)
+                etot += deg * e
+                utot += deg * (g**2 + f**2)
+            frac = occ / (2.0 * (2 * l + 1))
+            esum += frac * etot
+            rho += frac * utot / (4.0 * np.pi)
+        else:
+            e, u = find_bound_state(r, v, l, nql, e_lo=e_floor)
+            esum += occ * e
+            rho += occ * u**2 / (4.0 * np.pi)
+    nmt = len(r_mt)
+    leak = 4.0 * np.pi * np.trapezoid(rho[nmt:] * r[nmt:] ** 2, r[nmt:])
+    return rho[:nmt], esum, leak
+
+
+def run_scf_fp(cfg, base_dir: str = ".") -> dict:
+    """Ground state of a full-potential LAPW deck; returns the reference-
+    shaped result dict (reference dft_ground_state.find + json output)."""
+    t0 = time.time()
+    p = cfg.parameters
+    ctx = FpContext(cfg, base_dir)
+    nat = len(ctx.positions)
+    lmmax_pot = num_lm(ctx.lmax_pot)
+    nev = ctx.num_fv_states
+    rel_core = p.core_relativity
+    rel_val = p.valence_relativity
+
+    # ---- initial density: free-atom superposition ----
+    rho_mt = [free_atom_rho_mt(sp, ctx.lmax_rho) for sp in ctx.species_of_atom]
+    rho_ig = free_atom_rho_g(
+        ctx.species_of_atom, ctx.positions, ctx.gvec.millers, ctx.gvec.gcart,
+        ctx.omega,
+    )
+
+    def pack(rho_ig, rho_mt):
+        return np.concatenate(
+            [rho_ig.view(float)] + [m.ravel() for m in rho_mt]
+        )
+
+    def unpack(x):
+        ng2 = 2 * ctx.gvec.num_gvec
+        ig = x[:ng2].view(complex)
+        mts, off = [], ng2
+        for sp in ctx.species_of_atom:
+            sz = num_lm(ctx.lmax_rho) * sp.nrmt
+            mts.append(x[off : off + sz].reshape(num_lm(ctx.lmax_rho), sp.nrmt))
+            off += sz
+        return ig, mts
+
+    mixer = Mixer(cfg.mixer)
+    n = np.prod(ctx.dims)
+    etot_history, rms_history = [], []
+    e = {}
+    mu, entropy_sum, occ = 0.0, 0.0, None
+    evals_k = None
+    converged = False
+    num_done = 0
+    core_esum_tot = 0.0
+
+    for it in range(p.num_dft_iter):
+        # ---- potential from current density ----
+        # Hartree: Weinert pseudocharge
+        qmt = []
+        for ia in range(nat):
+            sp = ctx.species_of_atom[ia]
+            q = mt_multipoles(rho_mt[ia], sp.r)
+            q[0] += -sp.zn * Y00  # nuclear point charge
+            qmt.append(q)
+        qpw = [
+            pw_sphere_multipoles(
+                rho_ig, ctx.gvec.millers, ctx.gvec.gcart, ctx.positions[ia],
+                ctx.rmt[ia], ctx.lmax_pot,
+            )
+            for ia in range(nat)
+        ]
+        dq = [qmt[ia] - qpw[ia] for ia in range(nat)]
+        rho_ps = pseudo_density_g(
+            rho_ig, ctx.gvec.millers, ctx.gvec.gcart, ctx.omega, ctx.positions,
+            ctx.rmt, dq, ctx.lmax_pot,
+        )
+        vh_ig = interstitial_potential_g(rho_ps, ctx.gvec.glen2)
+        vh_mt, v_el_nuc = [], []
+        for ia in range(nat):
+            sp = ctx.species_of_atom[ia]
+            vb = sphere_boundary_lm(
+                vh_ig, ctx.gvec.millers, ctx.gvec.gcart, ctx.positions[ia],
+                ctx.rmt[ia], ctx.lmax_pot,
+            )
+            v, v00 = mt_coulomb_potential(
+                rho_mt[ia][:lmmax_pot], sp.r, sp.zn, vb
+            )
+            vh_mt.append(v)
+            v_el_nuc.append(v00)
+
+        # XC
+        box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
+        box[ctx.gvec.fft_index] = rho_ig
+        rho_r = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
+        vxc_r, exc_r = interstitial_xc(rho_r, ctx.xc)
+        vxc_mt, exc_mt = [], []
+        for ia in range(nat):
+            v, ex, _ = mt_xc(rho_mt[ia], ctx.species_of_atom[ia].r, ctx.xc, ctx.sht)
+            vxc_mt.append(v)
+            exc_mt.append(ex)
+
+        # effective potential
+        box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
+        box[ctx.gvec.fft_index] = vh_ig
+        vh_r = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
+        veff_r = vh_r + vxc_r
+        veff_mt = [vh_mt[ia] + vxc_mt[ia] for ia in range(nat)]
+
+        # ---- radial basis at the current spherical potential ----
+        basis_by_atom = []
+        core_rho, core_esum, core_leak = [], 0.0, 0.0
+        for ia in range(nat):
+            sp = ctx.species_of_atom[ia]
+            v_sph = veff_mt[ia][0] * Y00  # includes -Z/r
+            basis_by_atom.append(
+                build_radial_basis(sp, v_sph, ctx.lmax_apw, rel_val)
+            )
+            cr, ce, cl = core_states_density(sp, v_sph, rel_core)
+            core_rho.append(cr)
+            core_esum += ce
+            core_leak += cl
+        core_esum_tot = core_esum
+
+        # ---- band problem per k ----
+        th_box = np.fft.fftn(ctx.theta_r) / n
+        vth_box = np.fft.fftn(veff_r * ctx.theta_r) / n
+        evals_k, C_k = [], []
+        for ik, k in enumerate(ctx.kpoints):
+            H, O = assemble_fv(
+                ctx.gkmill[ik], k, ctx.lattice, ctx.positions, ctx.rmt,
+                basis_by_atom,
+                [v[:lmmax_pot] for v in veff_mt],
+                th_box, vth_box, ctx.dims, ctx.omega,
+            )
+            ev, C = diagonalize_fv(H, O, nev)
+            evals_k.append(ev)
+            C_k.append(C)
+        evals = np.asarray(evals_k)[:, None, :]  # [nk, 1, nev]
+
+        mu, occ, entropy_sum = find_fermi(
+            evals, np.asarray(ctx.kweights), float(ctx.num_valence),
+            p.smearing_width, kind=p.smearing, max_occupancy=2.0,
+        )
+        occ2 = np.asarray(occ)[:, 0, :]  # [nk, nev]
+
+        # ---- new density ----
+        # lo ordering must match assemble_fv's lo_index (loop-invariant)
+        lo_index = []
+        for ja in range(nat):
+            for ilo, lof in enumerate(basis_by_atom[ja].lo):
+                for m in range(-lof.l, lof.l + 1):
+                    lo_index.append((ja, ilo, lof.l, m))
+        gk_cart_k = [
+            (ctx.gkmill[ik] + k) @ ctx.recip
+            for ik, k in enumerate(ctx.kpoints)
+        ]
+        rho_mt_new = []
+        for ia in range(nat):
+            sp = ctx.species_of_atom[ia]
+            b = basis_by_atom[ia]
+            rf, lm_of, rf_of = mt_index(b, ctx.lmax_apw)
+            nidx = len(lm_of)
+            D = np.zeros((nidx, nidx), dtype=np.complex128)
+            for ik, k in enumerate(ctx.kpoints):
+                A, B = matching_coefficients(
+                    gk_cart_k[ik], ctx.positions[ia], ctx.gkmill[ik], k,
+                    ctx.rmt[ia], b, ctx.omega,
+                )
+                cols = atom_lo_cols(lo_index, ia, len(ctx.gkmill[ik]))
+                W = mt_expansion_coeffs(
+                    C_k[ik], A, B, cols, b, ctx.lmax_apw
+                )
+                wocc = ctx.kweights[ik] * occ2[ik]
+                D += (np.conj(W) * wocc[None, :]) @ W.T
+            rho = mt_density_from_dm(D, lm_of, rf_of, rf, ctx.lmax_rho, ctx.lmax_apw)
+            rho[0] += core_rho[ia] / Y00
+            rho_mt_new.append(rho)
+        rho_r_new = interstitial_density_box(
+            C_k, ctx.gkmill, occ2, ctx.kweights, ctx.dims, ctx.omega
+        )
+        # spread the core spill-out uniformly over the interstitial
+        # (reference density.cpp: core leakage -> constant background)
+        vol_i = ctx.istl_integral(np.ones(ctx.dims), np.ones(ctx.dims))
+        rho_r_new += core_leak / vol_i
+        rho_ig_new = np.fft.fftn(rho_r_new).ravel()[ctx.gvec.fft_index] / n
+
+        sq4pi_ = np.sqrt(4.0 * np.pi)
+        mt_charge = sum(
+            sq4pi_ * float(rint(rho_mt_new[ia][0] * ctx.species_of_atom[ia].r ** 2,
+                                ctx.species_of_atom[ia].r))
+            for ia in range(nat)
+        )
+        istl_charge = ctx.istl_integral(rho_r_new, np.ones(ctx.dims))
+        total_charge = mt_charge + istl_charge
+
+        # ---- energies (at the INPUT potential, OUTPUT density) ----
+        eval_sum = float(
+            np.sum(np.asarray(ctx.kweights)[:, None] * occ2 * np.asarray(evals_k))
+        ) + core_esum
+        rho_mt_tot = rho_mt_new
+        e_veff = ctx.mt_integral(rho_mt_tot, veff_mt) + ctx.istl_integral(
+            rho_r_new, veff_r
+        )
+        e_vha = ctx.mt_integral(rho_mt_tot, vh_mt) + ctx.istl_integral(
+            rho_r_new, vh_r
+        )
+        e_vxc = ctx.mt_integral(rho_mt_tot, vxc_mt) + ctx.istl_integral(
+            rho_r_new, vxc_r
+        )
+        sq4pi = np.sqrt(4.0 * np.pi)
+        e_exc = sum(
+            float(rint(exc_mt[ia][0] * ctx.species_of_atom[ia].r ** 2,
+                               ctx.species_of_atom[ia].r)) * sq4pi
+            for ia in range(nat)
+        ) + ctx.istl_integral(exc_r, np.ones(ctx.dims))
+        e_enuc = -0.5 * sum(
+            ctx.species_of_atom[ia].zn * v_el_nuc[ia] for ia in range(nat)
+        )
+        e_kin = eval_sum - e_veff
+        e_total = e_kin + e_exc + 0.5 * e_vha + e_enuc
+        e = {
+            "total": e_total,
+            "free": e_total + float(entropy_sum),
+            "eval_sum": eval_sum,
+            "core_eval_sum": core_esum,
+            "kin": e_kin,
+            "veff": e_veff,
+            "vha": e_vha,
+            "vxc": e_vxc,
+            "exc": e_exc,
+            "enuc": e_enuc,
+            "ewald": 0.0,
+            "bxc": 0.0,
+            "entropy_sum": float(entropy_sum),
+            "scf_correction": 0.0,
+        }
+        etot_history.append(e_total)
+
+        # ---- mix ----
+        x_in = pack(rho_ig, rho_mt)
+        x_out = pack(rho_ig_new, rho_mt_new)
+        rms = float(np.sqrt(np.mean(np.abs(x_out - x_in) ** 2)))
+        rms_history.append(rms)
+        num_done = it + 1
+        de = (
+            abs(etot_history[-1] - etot_history[-2])
+            if len(etot_history) > 1
+            else np.inf
+        )
+        if rms < p.density_tol and de < p.energy_tol:
+            converged = True
+            rho_ig, rho_mt = rho_ig_new, rho_mt_new
+            break
+        x_mix = mixer.mix(x_in, x_out)
+        rho_ig, rho_mt = unpack(x_mix)
+
+    band_gap = 0.0
+    ev_flat = np.asarray(evals_k)
+    o_flat = occ2
+    filled = ev_flat[o_flat > 1e-8 * 2.0]
+    empty = ev_flat[o_flat <= 1e-8 * 2.0]
+    if len(empty) and len(filled):
+        band_gap = max(0.0, float(empty.min() - filled.max()))
+
+    return {
+        "converged": converged,
+        "num_scf_iterations": num_done,
+        "efermi": float(mu),
+        "band_gap": band_gap,
+        "rho_min": 0.0,
+        "etot_history": etot_history,
+        "rms_history": rms_history,
+        "scf_time": time.time() - t0,
+        "energy": e,
+        "mt_charge": mt_charge,
+        "interstitial_charge": istl_charge,
+        "total_charge": total_charge,
+        "core_leakage": core_leak,
+        "band_energies": np.asarray(evals_k)[:, None, :].tolist(),
+        "band_occupancies": occ2[:, None, :].tolist(),
+        "counters": {},
+        "timers": {},
+    }
+
+
+def run_scf_fp_from_file(path: str, base_dir: str | None = None) -> dict:
+    import os
+
+    cfg = load_config(path)
+    if base_dir is None:
+        base_dir = os.path.dirname(os.path.abspath(path))
+    return run_scf_fp(cfg, base_dir)
