@@ -672,6 +672,22 @@ def run_scf_from_file(
     cfg = load_config(path)
     base_dir = os.path.dirname(os.path.abspath(path))
     state_file = os.path.join(base_dir, "sirius.h5")
+    if cfg.parameters.electronic_structure_method == "full_potential_lapwlo":
+        # FP-LAPW branch (reference dft_ground_state FP path); tasks other
+        # than the ground state are PP-PW-only for now
+        from sirius_tpu.lapw.scf_fp import run_scf_fp
+
+        result = run_scf_fp(cfg, base_dir)
+        out = {"ground_state": result, "task": task, "context": {}}
+        with open("output.json", "w") as f:
+            json.dump(out, f, indent=2, default=float)
+        if test_against:
+            with open(test_against) as f:
+                refgs = json.load(f)["ground_state"]
+            de = abs(refgs["energy"]["total"] - result["energy"]["total"])
+            print(f"total energy difference: {de:.3e}")
+            return 0 if de < 1e-5 else 1
+        return 0
     ref = None
     if test_against:
         with open(test_against) as f:

@@ -70,8 +70,10 @@ def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
     atom [lmmax_pot, nr] REAL-harmonic non-spherical potential (the
     spherical lm=0 component must be EXCLUDED — it lives in the radial
     basis through hf)."""
+    # rows of recip are b_i (a_i . b_j = 2 pi delta_ij): gcart = m @ recip,
+    # NOT m @ recip.T (equal only for symmetric lattice matrices)
     recip = 2.0 * np.pi * np.linalg.inv(lattice).T
-    gk_cart = (gk_millers + k_frac) @ recip.T
+    gk_cart = (gk_millers + k_frac) @ recip
     ng = len(gk_millers)
     nat = len(positions)
     # lo layout
@@ -180,12 +182,21 @@ def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
 
 
 def diagonalize_fv(H, O, nev: int):
-    """Lowest nev of the generalized problem via scipy-free Cholesky-or-
-    eigh regularized solve (same approach as solvers/eigen.py)."""
-    s, u = np.linalg.eigh(O)
-    good = s > 1e-9 * s.max()
-    t = u[:, good] * (1.0 / np.sqrt(s[good]))[None, :]
-    a = t.conj().T @ H @ t
-    e, c = np.linalg.eigh(a)
-    v = t @ c[:, :nev]
-    return e[:nev], v
+    """Lowest nev of the generalized problem. LAPACK's subset driver
+    (Cholesky + syevr) is ~6x faster than a full eigh at LAPW sizes when
+    nev << n; fall back to an explicitly regularized transform when the
+    overlap is numerically singular (near-dependent lo + APW sets)."""
+    nev = min(nev, H.shape[0])
+    try:
+        from scipy.linalg import eigh as seigh
+
+        e, v = seigh(H, O, subset_by_index=[0, nev - 1])
+        return e, v
+    except (ImportError, ValueError, np.linalg.LinAlgError):
+        s, u = np.linalg.eigh(O)
+        good = s > 1e-9 * s.max()
+        t = u[:, good] * (1.0 / np.sqrt(s[good]))[None, :]
+        a = t.conj().T @ H @ t
+        e, c = np.linalg.eigh(a)
+        v = t @ c[:, :nev]
+        return e[:nev], v

@@ -107,11 +107,18 @@ def pseudo_density_g(rho_i_g, millers, gcart, omega, positions, rmt, dq_by_atom,
     return out
 
 
-def interstitial_potential_g(rho_pseudo_g, glen2):
-    """V(G) = 4 pi rho(G) / G^2, V(0) = 0 (charge-neutral cell)."""
+def interstitial_potential_g(rho_pseudo_g, glen2, molecule_rcut: float = 0.0):
+    """V(G) = 4 pi rho(G) / G^2, V(0) = 0 (charge-neutral cell).
+
+    molecule_rcut > 0 switches to the cutoff-Coulomb kernel
+    4 pi rho / G^2 * (1 - cos(G R_cut)) that removes spurious periodic-
+    image interactions for molecules-in-a-box (reference poisson.cpp:204,
+    Jarvis/White/Godby/Payne PRB 56, 14972; R_cut = Omega^{1/3}/2)."""
     out = np.zeros_like(rho_pseudo_g)
     nz = glen2 > 1e-12
     out[nz] = 4.0 * np.pi * rho_pseudo_g[nz] / glen2[nz]
+    if molecule_rcut > 0.0:
+        out[nz] *= 1.0 - np.cos(np.sqrt(glen2[nz]) * molecule_rcut)
     return out
 
 

@@ -153,18 +153,56 @@ def find_bound_state(r, veff, l: int, n: int, rel: str = "none",
         if hi - lo < tol * max(1.0, abs(lo)):
             break
     E = 0.5 * (lo + hi)
-    p, _, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
-    # outward integration amplifies the e^{+kappa r} junk solution beyond
-    # the classical turning point; cut the tail at its |p| minimum after
-    # the peak (it should be ~0 for a converged bound state)
-    ipk = int(np.argmax(np.abs(p)))
-    icut = ipk + int(np.argmin(np.abs(p[ipk:])))
-    if icut < len(p) - 1 and abs(p[icut]) < 1e-6 * abs(p[ipk]):
-        p = p.copy()
-        p[icut:] = 0.0
+    ncut = _decay_cutoff_index(r, veff, l, E)
+    p_c, _, _ = integrate_outward(r[:ncut], veff[:ncut], l, E, rel)
+    p = np.zeros(len(r))
+    p[:ncut] = p_c
+    p = _cut_forbidden_tail(p, r, veff, l, E)
     u = p / r
     nrm = np.sqrt(rint(p * p, r))
     return E, u / nrm
+
+
+def _decay_cutoff_index(r, veff, l: int, E: float) -> int:
+    """Index bounding the solve domain for a bound state: past the
+    classical turning point the physical solution decays like
+    e^{-kappa (r - r_t)}; integrating much beyond underflows it to zero
+    while the junk solution overflows. Keep ~30 decay lengths."""
+    vl = veff + 0.5 * l * (l + 1) / np.maximum(r, 1e-30) ** 2
+    inside = np.nonzero(E > vl)[0]
+    if not len(inside):
+        return len(r)
+    rt = r[inside[-1]]
+    kappa = np.sqrt(max(2.0 * abs(E), 1e-3))
+    rmax = rt + 30.0 / kappa
+    ncut = int(np.searchsorted(r, rmax)) + 1
+    return max(8, min(ncut, len(r)))
+
+
+def _cut_forbidden_tail(p, r, veff, l: int, E: float, q=None):
+    """Zero the outward solution beyond its |p| minimum past the classical
+    turning point: outward integration amplifies the e^{+kappa r} junk
+    solution there (for deep states the overflow rescaling even makes the
+    junk the global maximum), so the tail carries no physics."""
+    vl = veff + 0.5 * l * (l + 1) / np.maximum(r, 1e-30) ** 2
+    inside = np.nonzero(E > vl)[0]
+    it0 = int(inside[-1]) if len(inside) else 0
+    if it0 >= len(p) - 2 or it0 < 3:
+        return p if q is None else (p, q)
+    # exact zeros are padding from a truncated solve, not the physical
+    # minimum — exclude them from the decay/junk crossover search
+    tail = np.abs(p[it0:]).astype(float)
+    tail[tail == 0.0] = np.inf
+    if not np.isfinite(tail).any():
+        return p if q is None else (p, q)
+    icut = it0 + int(np.argmin(tail))
+    if 3 <= icut < len(p) - 1 and np.abs(p[:icut]).max() > 0:
+        p = p.copy()
+        p[icut:] = 0.0
+        if q is not None:
+            q = q.copy()
+            q[icut:] = 0.0
+    return p if q is None else (p, q)
 
 
 def find_enu_band(r, veff, l: int, n: int, rel: str = "none"):
@@ -233,11 +271,12 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
     zeff = max(-veff[0] * r[0], 1e-8)
     gamma = np.sqrt(max(kappa * kappa - (zeff * ALPHA) ** 2, 1e-12))
 
-    def integrate(E):
+    def integrate(E, nstop=None):
+        nn = nmax if nstop is None else nstop
         aPQ = ALPHA * (E - v2 + two_c2)
         aQP = -ALPHA * (E - v2)
-        P = np.empty(nmax)
-        Q = np.empty(nmax)
+        P = np.zeros(nmax)
+        Q = np.zeros(nmax)
         P[0] = r[0] ** gamma
         Q[0] = P[0] * (gamma + kappa) / (zeff * ALPHA)
         yp, yq = P[0], Q[0]
@@ -249,7 +288,7 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
                 kappa * inv_r[i2] * qq + aQP[i2] * pp,
             )
 
-        for i in range(nmax - 1):
+        for i in range(nn - 1):
             h = r[i + 1] - r[i]
             i0, im, i1 = 2 * i, 2 * i + 1, 2 * i + 2
             k1p, k1q = f(i0, yp, yq)
@@ -281,7 +320,8 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
         if hi - lo < tol * max(1.0, abs(lo)):
             break
     E = 0.5 * (lo + hi)
-    P, Q, _ = integrate(E)
+    P, Q, _ = integrate(E, nstop=_decay_cutoff_index(r, veff, l, E))
+    P, Q = _cut_forbidden_tail(P, r, veff, l, E, q=Q)
     nrm = np.sqrt(rint(P * P + Q * Q, r))
     return E, (P / nrm) / r, (Q / nrm) / r
 

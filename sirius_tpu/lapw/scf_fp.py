@@ -22,7 +22,7 @@ import time
 
 import numpy as np
 
-from sirius_tpu.lapw.quad import rint
+from sirius_tpu.lapw.quad import radial_weights, rint
 
 from sirius_tpu.config.schema import load_config
 from sirius_tpu.core.fftgrid import FFTGrid
@@ -80,15 +80,30 @@ class FpContext:
                 label, os.path.join(base_dir, fname)
             )
         self.labels = []
-        pos = []
+        pos, moments = [], []
+        units = uc.atom_coordinate_units
+        bohr_radius = 0.52917721067  # reference core/constants.hpp:28
         for label in uc.atom_types:
             for v in uc.atoms.get(label, []):
-                pos.append(np.asarray(v[:3], float))
+                x = np.asarray(v[:3], float)
+                if units == "A":
+                    x = x / bohr_radius
+                if units in ("A", "au"):
+                    x = x @ np.linalg.inv(a)  # cartesian -> fractional
+                pos.append(np.mod(x, 1.0))
+                moments.append(
+                    np.asarray(v[3:6], float) if len(v) >= 6 else np.zeros(3)
+                )
                 self.labels.append(label)
         self.positions = np.asarray(pos)
+        self.moments = np.asarray(moments)
+        self.num_mag_dims = p.num_mag_dims
         self.species_of_atom = [self.species[l] for l in self.labels]
-        self.rmt = np.asarray([sp.rmt for sp in self.species_of_atom])
         self.zn_tot = sum(sp.zn for sp in self.species_of_atom)
+
+        if p.auto_rmt:
+            self._auto_rmt(p.auto_rmt, cfg.control.rmt_max)
+        self.rmt = np.asarray([sp.rmt for sp in self.species_of_atom])
 
         self.lmax_apw = p.lmax_apw
         self.lmax_rho = p.lmax_rho
@@ -111,7 +126,9 @@ class FpContext:
 
         # k-mesh
         self.sym = CrystalSymmetry.find(
-            a, self.positions, np.asarray([hash(l) for l in self.labels])
+            a, self.positions, np.asarray([hash(l) for l in self.labels]),
+            moments=self.moments if p.num_mag_dims else None,
+            num_mag_dims=p.num_mag_dims,
         ) if p.use_symmetry else None
         self.kpoints, self.kweights = irreducible_kmesh(
             p.ngridk, p.shiftk, self.sym, use_symmetry=p.use_symmetry
@@ -138,6 +155,62 @@ class FpContext:
         self.sht = MtSht(self.lmax_rho, self.lmax_pot)
         self.xc = XCFunctional(p.xc_functionals)
 
+    def _auto_rmt(self, mode: int, rmt_max: float) -> None:
+        """Recompute MT radii from nearest-neighbour distances and rebuild
+        the species' radial grids (reference Unit_cell::find_mt_radii,
+        unit_cell.cpp:30, auto_rmt = 1 with inflate = true)."""
+        assert mode == 1, f"auto_rmt mode {mode} not implemented"
+        nat = len(self.positions)
+        types = list(dict.fromkeys(self.labels))
+        tid = {lab: i for i, lab in enumerate(types)}
+        # nearest neighbour over periodic images
+        img = np.array(
+            [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1) for k in (-1, 0, 1)]
+        )
+        nn_d = np.full(nat, np.inf)
+        nn_j = np.zeros(nat, dtype=int)
+        for ia in range(nat):
+            for ja in range(nat):
+                d = (self.positions[ja] + img - self.positions[ia]) @ self.lattice
+                dist = np.linalg.norm(d, axis=1)
+                dist[dist < 1e-10] = np.inf  # exclude self at zero shift
+                jmin = np.argmin(dist)
+                if dist[jmin] < nn_d[ia]:
+                    nn_d[ia] = dist[jmin]
+                    nn_j[ia] = ja
+        ntyp = len(types)
+        Rmt = np.full(ntyp, 1e10)
+        for ia in range(nat):
+            id1, id2 = tid[self.labels[ia]], tid[self.labels[nn_j[ia]]]
+            R = min(rmt_max, 0.95 * nn_d[ia] / 2)
+            Rmt[id1] = min(Rmt[id1], R)
+            Rmt[id2] = min(Rmt[id2], R)
+        # inflate pass: types whose spheres are far from touching may expand
+        # toward already-fixed neighbours
+        scale_ok = np.ones(ntyp, dtype=bool)
+        for ia in range(nat):
+            id1, id2 = tid[self.labels[ia]], tid[self.labels[nn_j[ia]]]
+            if Rmt[id1] + Rmt[id2] > nn_d[ia] * 0.94:
+                scale_ok[id1] = scale_ok[id2] = False
+        Rmt_infl = np.full(ntyp, 1e10)
+        for ia in range(nat):
+            id1, id2 = tid[self.labels[ia]], tid[self.labels[nn_j[ia]]]
+            if scale_ok[id1] and not scale_ok[id2]:
+                Rmt_infl[id1] = min(
+                    Rmt_infl[id1], min(rmt_max, 0.95 * (nn_d[ia] - Rmt[id2]))
+                )
+            else:
+                Rmt_infl[id1] = min(Rmt_infl[id1], Rmt[id1])
+        for lab in types:
+            sp = self.species[lab]
+            R = float(Rmt_infl[tid[lab]])
+            if R < 0.3:
+                raise ValueError(f"auto rmt too small for {lab}: {R}")
+            sp.rmt = R
+            sp.r = sp.rmin * (R / sp.rmin) ** (
+                np.arange(sp.nrmt) / (sp.nrmt - 1.0)
+            )
+
     def mt_integral(self, f_lm_by_atom, g_lm_by_atom) -> float:
         """sum_a sum_lm int f_lm g_lm r^2 dr (real-harmonic orthonormality)."""
         out = 0.0
@@ -149,6 +222,13 @@ class FpContext:
                 )
             )
         return out
+
+    def g2r(self, f_g: np.ndarray) -> np.ndarray:
+        """Real-space box from fine-G-set coefficients."""
+        n = int(np.prod(self.dims))
+        box = np.zeros(n, dtype=np.complex128)
+        box[self.gvec.fft_index] = f_g
+        return np.real(np.fft.ifftn(box.reshape(self.dims)) * n)
 
     def istl_integral(self, f_r, g_r) -> float:
         """(Omega/N) sum_r f g theta — interstitial region integral."""
@@ -189,7 +269,7 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
             esum += frac * etot
             rho += frac * utot / (4.0 * np.pi)
         else:
-            e, u = find_bound_state(r, v, l, nql, e_lo=e_floor)
+            e, u = find_bound_state(r, v, l, nql, rel=rel, e_lo=e_floor)
             esum += occ * e
             rho += occ * u**2 / (4.0 * np.pi)
     nmt = len(r_mt)
@@ -209,27 +289,60 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
     rel_core = p.core_relativity
     rel_val = p.valence_relativity
 
+    nm = p.num_mag_dims
+    if nm not in (0, 1):
+        raise NotImplementedError("FP-LAPW: only collinear magnetism so far")
+    ns = 2 if nm else 1
+
     # ---- initial density: free-atom superposition ----
     rho_mt = [free_atom_rho_mt(sp, ctx.lmax_rho) for sp in ctx.species_of_atom]
     rho_ig = free_atom_rho_g(
         ctx.species_of_atom, ctx.positions, ctx.gvec.millers, ctx.gvec.gcart,
         ctx.omega,
     )
+    mag_mt = None
+    mag_ig = np.zeros_like(rho_ig) if nm else None
+    if nm:
+        # scale the atomic density to carry the requested sphere moment
+        # (reference Density::initial_density mag branch)
+        mag_mt = []
+        for ia, sp in enumerate(ctx.species_of_atom):
+            q = np.sqrt(4 * np.pi) * float(rint(rho_mt[ia][0] * sp.r**2, sp.r))
+            mz = float(ctx.moments[ia][2])
+            mz = np.clip(mz, -q, q)
+            mag_mt.append(rho_mt[ia] * (mz / max(q, 1e-12)))
 
-    def pack(rho_ig, rho_mt):
-        return np.concatenate(
-            [rho_ig.view(float)] + [m.ravel() for m in rho_mt]
-        )
+    def pack(rho_ig, rho_mt, mag_ig=None, mag_mt=None):
+        parts = [rho_ig.view(float)]
+        if nm:
+            parts.append(mag_ig.view(float))
+        parts += [m.ravel() for m in rho_mt]
+        if nm:
+            parts += [m.ravel() for m in mag_mt]
+        return np.concatenate(parts)
 
     def unpack(x):
-        ng2 = 2 * ctx.gvec.num_gvec
-        ig = x[:ng2].view(complex)
-        mts, off = [], ng2
+        ngf = 2 * ctx.gvec.num_gvec
+        ig = x[:ngf].view(complex)
+        off = ngf
+        mig = None
+        if nm:
+            mig = x[off : off + ngf].view(complex)
+            off += ngf
+        lmmax_rho = num_lm(ctx.lmax_rho)
+        mts = []
         for sp in ctx.species_of_atom:
-            sz = num_lm(ctx.lmax_rho) * sp.nrmt
-            mts.append(x[off : off + sz].reshape(num_lm(ctx.lmax_rho), sp.nrmt))
+            sz = lmmax_rho * sp.nrmt
+            mts.append(x[off : off + sz].reshape(lmmax_rho, sp.nrmt))
             off += sz
-        return ig, mts
+        mmts = None
+        if nm:
+            mmts = []
+            for sp in ctx.species_of_atom:
+                sz = lmmax_rho * sp.nrmt
+                mmts.append(x[off : off + sz].reshape(lmmax_rho, sp.nrmt))
+                off += sz
+        return ig, mts, mig, mmts
 
     mixer = Mixer(cfg.mixer)
     n = np.prod(ctx.dims)
@@ -262,7 +375,10 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             rho_ig, ctx.gvec.millers, ctx.gvec.gcart, ctx.omega, ctx.positions,
             ctx.rmt, dq, ctx.lmax_pot,
         )
-        vh_ig = interstitial_potential_g(rho_ps, ctx.gvec.glen2)
+        vh_ig = interstitial_potential_g(
+            rho_ps, ctx.gvec.glen2,
+            molecule_rcut=(0.5 * ctx.omega ** (1.0 / 3.0) if p.molecule else 0.0),
+        )
         vh_mt, v_el_nuc = [], []
         for ia in range(nat):
             sp = ctx.species_of_atom[ia]
@@ -277,20 +393,25 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             v_el_nuc.append(v00)
 
         # XC
-        box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
-        box[ctx.gvec.fft_index] = rho_ig
-        rho_r = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
-        vxc_r, exc_r = interstitial_xc(rho_r, ctx.xc)
+        rho_r = ctx.g2r(rho_ig)
+        bxc_r, bxc_mt = None, [None] * nat
+        if nm:
+            mag_r = ctx.g2r(mag_ig)
+            vxc_r, exc_r, bxc_r = interstitial_xc(rho_r, ctx.xc, mag_r)
+        else:
+            vxc_r, exc_r = interstitial_xc(rho_r, ctx.xc)
         vxc_mt, exc_mt = [], []
         for ia in range(nat):
-            v, ex, _ = mt_xc(rho_mt[ia], ctx.species_of_atom[ia].r, ctx.xc, ctx.sht)
+            v, ex, bx = mt_xc(
+                rho_mt[ia], ctx.species_of_atom[ia].r, ctx.xc, ctx.sht,
+                mag_lm=mag_mt[ia] if nm else None,
+            )
             vxc_mt.append(v)
             exc_mt.append(ex)
+            bxc_mt[ia] = bx
 
         # effective potential
-        box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
-        box[ctx.gvec.fft_index] = vh_ig
-        vh_r = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
+        vh_r = ctx.g2r(vh_ig)
         veff_r = vh_r + vxc_r
         veff_mt = [vh_mt[ia] + vxc_mt[ia] for ia in range(nat)]
 
@@ -309,7 +430,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             core_leak += cl
         core_esum_tot = core_esum
 
-        # ---- band problem per k ----
+        # ---- band problem per k: first variation (no B field) ----
         th_box = np.fft.fftn(ctx.theta_r) / n
         vth_box = np.fft.fftn(veff_r * ctx.theta_r) / n
         evals_k, C_k = [], []
@@ -323,16 +444,9 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             ev, C = diagonalize_fv(H, O, nev)
             evals_k.append(ev)
             C_k.append(C)
-        evals = np.asarray(evals_k)[:, None, :]  # [nk, 1, nev]
 
-        mu, occ, entropy_sum = find_fermi(
-            evals, np.asarray(ctx.kweights), float(ctx.num_valence),
-            p.smearing_width, kind=p.smearing, max_occupancy=2.0,
-        )
-        occ2 = np.asarray(occ)[:, 0, :]  # [nk, nev]
-
-        # ---- new density ----
-        # lo ordering must match assemble_fv's lo_index (loop-invariant)
+        # MT expansion coefficients per (k, atom) — shared by the second
+        # variation and the density build
         lo_index = []
         for ja in range(nat):
             for ilo, lof in enumerate(basis_by_atom[ja].lo):
@@ -342,35 +456,171 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             (ctx.gkmill[ik] + k) @ ctx.recip
             for ik, k in enumerate(ctx.kpoints)
         ]
-        rho_mt_new = []
-        for ia in range(nat):
-            sp = ctx.species_of_atom[ia]
-            b = basis_by_atom[ia]
-            rf, lm_of, rf_of = mt_index(b, ctx.lmax_apw)
-            nidx = len(lm_of)
-            D = np.zeros((nidx, nidx), dtype=np.complex128)
-            for ik, k in enumerate(ctx.kpoints):
+        mtix = [mt_index(basis_by_atom[ia], ctx.lmax_apw) for ia in range(nat)]
+        W_k = []
+        for ik, k in enumerate(ctx.kpoints):
+            Ws = []
+            for ia in range(nat):
                 A, B = matching_coefficients(
                     gk_cart_k[ik], ctx.positions[ia], ctx.gkmill[ik], k,
-                    ctx.rmt[ia], b, ctx.omega,
+                    ctx.rmt[ia], basis_by_atom[ia], ctx.omega,
                 )
                 cols = atom_lo_cols(lo_index, ia, len(ctx.gkmill[ik]))
-                W = mt_expansion_coeffs(
-                    C_k[ik], A, B, cols, b, ctx.lmax_apw
+                Ws.append(
+                    mt_expansion_coeffs(
+                        C_k[ik], A, B, cols, basis_by_atom[ia], ctx.lmax_apw
+                    )
                 )
-                wocc = ctx.kweights[ik] * occ2[ik]
-                D += (np.conj(W) * wocc[None, :]) @ W.T
-            rho = mt_density_from_dm(D, lm_of, rf_of, rf, ctx.lmax_rho, ctx.lmax_apw)
+            W_k.append(Ws)
+
+        # ---- second variation: diagonal fv energies + sigma_z B coupling
+        # (reference diagonalize_fp.hpp second-variational branch) ----
+        if nm:
+            from sirius_tpu.lapw.fv import gaunt_hybrid as _gh
+
+            BMT = []
+            for ia in range(nat):
+                b = basis_by_atom[ia]
+                rf, lm_of, rf_of = mtix[ia]
+                gh = _gh(ctx.lmax_apw, ctx.lmax_pot, ctx.lmax_apw)
+                wr2 = radial_weights(b.r) * b.r * b.r
+                F = np.stack(rf)
+                RI = np.einsum(
+                    "ax,Lx,bx,x->abL", F, bxc_mt[ia][: num_lm(ctx.lmax_pot)],
+                    F, wr2, optimize=True,
+                )
+                GG = gh[lm_of[:, None], :, lm_of[None, :]]
+                BMT.append(
+                    np.einsum(
+                        "pqL,pqL->pq", GG,
+                        RI[rf_of[:, None], rf_of[None, :], :],
+                    )
+                )
+            bth_r = bxc_r * ctx.theta_r
+            evals_sv, U_k = [], []
+            for ik in range(len(ctx.kpoints)):
+                ngk = len(ctx.gkmill[ik])
+                i0 = np.mod(ctx.gkmill[ik][:, 0], ctx.dims[0])
+                i1 = np.mod(ctx.gkmill[ik][:, 1], ctx.dims[1])
+                i2 = np.mod(ctx.gkmill[ik][:, 2], ctx.dims[2])
+                PSI = np.zeros((nev, n), dtype=np.complex128)
+                for ib in range(nev):
+                    box = np.zeros(ctx.dims, dtype=np.complex128)
+                    box[i0, i1, i2] = C_k[ik][:ngk, ib]
+                    PSI[ib] = (np.fft.ifftn(box) * n / np.sqrt(ctx.omega)).ravel()
+                Bij = (ctx.omega / n) * (
+                    np.conj(PSI) @ (bth_r.ravel()[:, None] * PSI.T)
+                )
+                for ia in range(nat):
+                    W = W_k[ik][ia]
+                    Bij += W.conj().T @ BMT[ia] @ W
+                Bij = 0.5 * (Bij + Bij.conj().T)
+                evs, Us = [], []
+                for s in (+1, -1):
+                    hsv = np.diag(evals_k[ik]) + s * Bij
+                    ev_s, u_s = np.linalg.eigh(hsv)
+                    evs.append(ev_s)
+                    Us.append(u_s)
+                evals_sv.append(np.stack(evs))  # [2, nev]
+                U_k.append(Us)
+            evals = np.asarray(evals_sv)  # [nk, 2, nev]
+        else:
+            evals = np.asarray(evals_k)[:, None, :]  # [nk, 1, nev]
+            U_k = [[np.eye(nev, dtype=np.complex128)] for _ in ctx.kpoints]
+
+        mu, occ, entropy_sum = find_fermi(
+            evals, np.asarray(ctx.kweights), float(ctx.num_valence),
+            p.smearing_width, kind=p.smearing,
+            max_occupancy=(2.0 if ns == 1 else 1.0),
+        )
+        occ_np = np.asarray(occ)  # [nk, ns, nev]
+
+        # ---- new density (per spin channel) ----
+        rho_mt_new, mag_mt_new = [], []
+        for ia in range(nat):
+            b = basis_by_atom[ia]
+            rf, lm_of, rf_of = mtix[ia]
+            nidx = len(lm_of)
+            D_s = np.zeros((ns, nidx, nidx), dtype=np.complex128)
+            for ik in range(len(ctx.kpoints)):
+                W = W_k[ik][ia]
+                for ispn in range(ns):
+                    Wsv = W @ U_k[ik][ispn]
+                    wocc = ctx.kweights[ik] * occ_np[ik, ispn]
+                    D_s[ispn] += (np.conj(Wsv) * wocc[None, :]) @ Wsv.T
+            rho = mt_density_from_dm(
+                D_s.sum(axis=0), lm_of, rf_of, rf, ctx.lmax_rho, ctx.lmax_apw
+            )
             rho[0] += core_rho[ia] / Y00
             rho_mt_new.append(rho)
-        rho_r_new = interstitial_density_box(
-            C_k, ctx.gkmill, occ2, ctx.kweights, ctx.dims, ctx.omega
-        )
+            if nm:
+                mag_mt_new.append(
+                    mt_density_from_dm(
+                        D_s[0] - D_s[1], lm_of, rf_of, rf, ctx.lmax_rho,
+                        ctx.lmax_apw,
+                    )
+                )
+        if nm:
+            rho_r_new = np.zeros(ctx.dims)
+            mag_r_new = np.zeros(ctx.dims)
+            for ik in range(len(ctx.kpoints)):
+                ngk = len(ctx.gkmill[ik])
+                i0 = np.mod(ctx.gkmill[ik][:, 0], ctx.dims[0])
+                i1 = np.mod(ctx.gkmill[ik][:, 1], ctx.dims[1])
+                i2 = np.mod(ctx.gkmill[ik][:, 2], ctx.dims[2])
+                spin_rho = []
+                for ispn in range(ns):
+                    Csv = C_k[ik][:ngk] @ U_k[ik][ispn]
+                    acc = np.zeros(ctx.dims)
+                    for j in range(nev):
+                        f = ctx.kweights[ik] * occ_np[ik, ispn, j]
+                        if f < 1e-12:
+                            continue
+                        box = np.zeros(ctx.dims, dtype=np.complex128)
+                        box[i0, i1, i2] = Csv[:, j]
+                        psi = np.fft.ifftn(box) * n / np.sqrt(ctx.omega)
+                        acc += f * np.abs(psi) ** 2
+                    spin_rho.append(acc)
+                rho_r_new += spin_rho[0] + spin_rho[1]
+                mag_r_new += spin_rho[0] - spin_rho[1]
+        else:
+            rho_r_new = interstitial_density_box(
+                C_k, ctx.gkmill, occ_np[:, 0, :], ctx.kweights, ctx.dims,
+                ctx.omega,
+            )
         # spread the core spill-out uniformly over the interstitial
         # (reference density.cpp: core leakage -> constant background)
         vol_i = ctx.istl_integral(np.ones(ctx.dims), np.ones(ctx.dims))
         rho_r_new += core_leak / vol_i
         rho_ig_new = np.fft.fftn(rho_r_new).ravel()[ctx.gvec.fft_index] / n
+        if nm:
+            mag_ig_new = np.fft.fftn(mag_r_new).ravel()[ctx.gvec.fft_index] / n
+
+        # IBZ k-sums require the space-group projection of the density
+        # (reference symmetrize_field4d after generate_valence)
+        if ctx.sym is not None and len(ctx.sym.ops) > 1:
+            from sirius_tpu.lapw.symmetrize_fp import (
+                symmetrize_mt,
+                symmetrize_pw_fp,
+            )
+
+            rho_ig_new = symmetrize_pw_fp(
+                rho_ig_new, ctx.sym.ops, ctx.gvec.millers
+            )
+            rho_mt_new = symmetrize_mt(rho_mt_new, ctx.sym.ops, ctx.lmax_rho)
+            rho_r_new = ctx.g2r(rho_ig_new)
+            if nm:
+                # collinear m_z transforms as a scalar over the magnetic
+                # group (the finder already filtered moment-breaking ops)
+                mag_ig_new = symmetrize_pw_fp(
+                    mag_ig_new, ctx.sym.ops, ctx.gvec.millers
+                )
+                mag_mt_new = symmetrize_mt(
+                    mag_mt_new, ctx.sym.ops, ctx.lmax_rho
+                )
+                box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
+                box[ctx.gvec.fft_index] = mag_ig_new
+                mag_r_new = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
 
         sq4pi_ = np.sqrt(4.0 * np.pi)
         mt_charge = sum(
@@ -383,7 +633,10 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
 
         # ---- energies (at the INPUT potential, OUTPUT density) ----
         eval_sum = float(
-            np.sum(np.asarray(ctx.kweights)[:, None] * occ2 * np.asarray(evals_k))
+            np.sum(
+                np.asarray(ctx.kweights)[:, None, None] * occ_np
+                * np.asarray(evals)
+            )
         ) + core_esum
         rho_mt_tot = rho_mt_new
         e_veff = ctx.mt_integral(rho_mt_tot, veff_mt) + ctx.istl_integral(
@@ -404,7 +657,12 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         e_enuc = -0.5 * sum(
             ctx.species_of_atom[ia].zn * v_el_nuc[ia] for ia in range(nat)
         )
-        e_kin = eval_sum - e_veff
+        e_bxc = 0.0
+        if nm:
+            e_bxc = ctx.mt_integral(mag_mt_new, bxc_mt) + ctx.istl_integral(
+                mag_r_new, bxc_r
+            )
+        e_kin = eval_sum - e_veff - e_bxc
         e_total = e_kin + e_exc + 0.5 * e_vha + e_enuc
         e = {
             "total": e_total,
@@ -418,15 +676,18 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             "exc": e_exc,
             "enuc": e_enuc,
             "ewald": 0.0,
-            "bxc": 0.0,
+            "bxc": e_bxc,
             "entropy_sum": float(entropy_sum),
             "scf_correction": 0.0,
         }
         etot_history.append(e_total)
 
         # ---- mix ----
-        x_in = pack(rho_ig, rho_mt)
-        x_out = pack(rho_ig_new, rho_mt_new)
+        x_in = pack(rho_ig, rho_mt, mag_ig, mag_mt)
+        x_out = pack(
+            rho_ig_new, rho_mt_new,
+            mag_ig_new if nm else None, mag_mt_new if nm else None,
+        )
         rms = float(np.sqrt(np.mean(np.abs(x_out - x_in) ** 2)))
         rms_history.append(rms)
         num_done = it + 1
@@ -438,17 +699,37 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         if rms < p.density_tol and de < p.energy_tol:
             converged = True
             rho_ig, rho_mt = rho_ig_new, rho_mt_new
+            if nm:
+                mag_ig, mag_mt = mag_ig_new, mag_mt_new
             break
         x_mix = mixer.mix(x_in, x_out)
-        rho_ig, rho_mt = unpack(x_mix)
+        rho_ig, rho_mt, mag_ig, mag_mt = unpack(x_mix)
 
     band_gap = 0.0
-    ev_flat = np.asarray(evals_k)
-    o_flat = occ2
-    filled = ev_flat[o_flat > 1e-8 * 2.0]
-    empty = ev_flat[o_flat <= 1e-8 * 2.0]
+    ev_flat = np.asarray(evals)
+    o_flat = occ_np
+    maxocc = 2.0 if ns == 1 else 1.0
+    filled = ev_flat[o_flat > 1e-8 * maxocc]
+    empty = ev_flat[o_flat <= 1e-8 * maxocc]
     if len(empty) and len(filled):
         band_gap = max(0.0, float(empty.min() - filled.max()))
+
+    mag_result = None
+    if nm:
+        mt_moments = [
+            float(
+                np.sqrt(4.0 * np.pi)
+                * rint(mag_mt[ia][0] * ctx.species_of_atom[ia].r ** 2,
+                       ctx.species_of_atom[ia].r)
+            )
+            for ia in range(nat)
+        ]
+        mr = ctx.g2r(mag_ig)
+        m_tot = sum(mt_moments) + ctx.istl_integral(mr, np.ones(ctx.dims))
+        mag_result = {
+            "total": [0.0, 0.0, m_tot],
+            "atoms": [[0.0, 0.0, m] for m in mt_moments],
+        }
 
     return {
         "converged": converged,
@@ -464,10 +745,11 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         "interstitial_charge": istl_charge,
         "total_charge": total_charge,
         "core_leakage": core_leak,
-        "band_energies": np.asarray(evals_k)[:, None, :].tolist(),
-        "band_occupancies": occ2[:, None, :].tolist(),
+        "band_energies": np.asarray(evals).tolist(),
+        "band_occupancies": occ_np.tolist(),
         "counters": {},
         "timers": {},
+        **({"magnetisation": mag_result} if mag_result else {}),
     }
 
 

@@ -108,14 +108,18 @@ class FpSpecies:
         return self.aw_specific.get(l, self.aw_default)
 
     def core_states(self) -> list:
-        """[(n, l, occupancy)] parsed from the core string '1s2 2s2 2p6'."""
-        out = []
+        """[(n, l, occupancy)] from the core string '1s2s2p' — pairs of
+        (n, l-letter), each a FULL shell (reference read_input_core,
+        atom_type.cpp:376)."""
+        s = self.core.strip().replace(" ", "")
+        if len(s) % 2:
+            raise ValueError(f"wrong core configuration string: {self.core}")
         lmap = {"s": 0, "p": 1, "d": 2, "f": 3}
-        for tok in self.core.split():
-            n = int(tok[0])
-            l = lmap[tok[1]]
-            occ = float(tok[2:]) if len(tok) > 2 else 2.0 * (2 * l + 1)
-            out.append((n, l, occ))
+        out = []
+        for j in range(0, len(s), 2):
+            n = int(s[j])
+            l = lmap[s[j + 1]]
+            out.append((n, l, 2.0 * (2 * l + 1)))
         return out
 
 

@@ -53,6 +53,12 @@ def mt_xc(rho_lm, r, xc, sht: MtSht, mag_lm=None):
     mag_lm (z-component in real harmonics)."""
     import jax.numpy as jnp
 
+    if xc.is_gga:
+        raise NotImplementedError(
+            "FP-LAPW muffin-tin XC is LDA-only so far; GGA needs the MT "
+            "density gradient (reference xc_mt.cpp GGA branch)"
+        )
+
     rho_pt = np.maximum(sht.to_grid(rho_lm), 1e-12)  # [np, nr]
     if mag_lm is None:
         res = xc.evaluate(jnp.asarray(rho_pt.ravel()))
@@ -74,14 +80,24 @@ def mt_xc(rho_lm, r, xc, sht: MtSht, mag_lm=None):
     )
 
 
-def interstitial_xc(rho_r, xc):
-    """(vxc_r, exc_density_r) pointwise on the FFT grid (full cell; the
-    integrals later weight by the step function)."""
+def interstitial_xc(rho_r, xc, mag_r=None):
+    """(vxc_r, exc_density_r[, bxc_r]) pointwise on the FFT grid (full
+    cell; the integrals later weight by the step function). Collinear
+    magnetism via mag_r (z-component)."""
     import jax.numpy as jnp
 
     shape = rho_r.shape
     rho = np.maximum(rho_r, 1e-12)
-    res = xc.evaluate(jnp.asarray(rho.ravel()))
-    v = np.asarray(res["v"]).reshape(shape)
+    if mag_r is None:
+        res = xc.evaluate(jnp.asarray(rho.ravel()))
+        v = np.asarray(res["v"]).reshape(shape)
+        e = np.asarray(res["e"]).reshape(shape)
+        return v, e
+    m = np.clip(mag_r, -rho + 1e-12, rho - 1e-12)
+    res = xc.evaluate_polarized(
+        jnp.asarray((0.5 * (rho + m)).ravel()), jnp.asarray((0.5 * (rho - m)).ravel())
+    )
+    vu = np.asarray(res["v_up"]).reshape(shape)
+    vd = np.asarray(res["v_dn"]).reshape(shape)
     e = np.asarray(res["e"]).reshape(shape)
-    return v, e
+    return 0.5 * (vu + vd), e, 0.5 * (vu - vd)

@@ -39,7 +39,7 @@ def _gvec_set(lattice, cutoff):
     rng = np.arange(-nmax, nmax + 1)
     mi, mj, mk = np.meshgrid(rng, rng, rng, indexing="ij")
     mill = np.stack([mi.ravel(), mj.ravel(), mk.ravel()], axis=1)
-    g = mill @ recip.T
+    g = mill @ recip
     keep = np.linalg.norm(g, axis=1) <= cutoff
     return mill[keep]
 
@@ -66,7 +66,7 @@ def test_empty_lattice_free_electrons(kfrac):
     recip = 2.0 * np.pi * np.linalg.inv(lattice).T
     pos = np.array([[0.0, 0.0, 0.0]])
     theta = step_function_g(
-        lattice, pos, np.array([rmt]), mill_fine @ recip.T, mill_fine
+        lattice, pos, np.array([rmt]), mill_fine @ recip, mill_fine
     )
     # theta(0) identity: 1 - 4pi R^3/(3 Omega)
     assert abs(theta[0].real - (1 - 4 * np.pi * rmt**3 / 3 / omega)) < 1e-12
@@ -82,6 +82,6 @@ def test_empty_lattice_free_electrons(kfrac):
     # ~1e-3 there; higher shells sit further from the linearization energy
     nev = 7
     e, v = diagonalize_fv(H, O, nev)
-    gk = (mill + k) @ recip.T
+    gk = (mill + k) @ recip
     e_free = np.sort(0.5 * np.sum(gk**2, axis=1))[:nev]
     assert np.abs(e - e_free).max() < 2e-3, (e, e_free)

@@ -24,7 +24,7 @@ def _setup(a=8.0, nmax=9):
     rng = np.arange(-nmax, nmax + 1)
     mi, mj, mk = np.meshgrid(rng, rng, rng, indexing="ij")
     mill = np.stack([mi.ravel(), mj.ravel(), mk.ravel()], axis=1)
-    g = mill @ recip.T
+    g = mill @ recip
     return lattice, mill, g
 
 

@@ -45,7 +45,12 @@ def run_deck(name: str) -> dict:
     cfg = load_config(os.path.join(base, "sirius.json"))
     ref = json.load(open(os.path.join(base, "output_ref.json")))["ground_state"]
     t0 = time.time()
-    res = run_scf(cfg, base_dir=base)
+    if cfg.parameters.electronic_structure_method == "full_potential_lapwlo":
+        from sirius_tpu.lapw.scf_fp import run_scf_fp
+
+        res = run_scf_fp(cfg, base_dir=base)
+    else:
+        res = run_scf(cfg, base_dir=base)
     wall = time.time() - t0
     de = abs(res["energy"]["total"] - ref["energy"]["total"])
     rec = {
