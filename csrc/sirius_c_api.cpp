@@ -1,0 +1,309 @@
+/* C API for embedding the framework in Fortran/C hosts (QE, CP2K).
+ *
+ * Mirrors the handle-based surface of the reference C API
+ * (src/api/sirius_api.cpp): contexts are opaque handles, every call takes
+ * a trailing int* error_code (0 = success). The implementation embeds
+ * CPython and forwards to sirius_tpu.capi; the jax/XLA compute core runs
+ * unchanged underneath.
+ *
+ * Build:  g++ -O2 -shared -fPIC sirius_c_api.cpp \
+ *             $(python3-config --includes) $(python3-config --ldflags --embed) \
+ *             -o libsirius_tpu.so
+ */
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mutex;
+bool g_py_owned = false; /* we called Py_Initialize ourselves */
+PyObject* g_mod = nullptr;
+
+bool ensure_python()
+{
+    if (g_mod) {
+        return true;
+    }
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_py_owned = true;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    g_mod = PyImport_ImportModule("sirius_tpu.capi");
+    if (!g_mod) {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return g_mod != nullptr;
+}
+
+/* call sirius_tpu.capi.<fn>(args...); returns new ref or nullptr */
+PyObject* call(const char* fn, PyObject* args)
+{
+    PyObject* f = PyObject_GetAttrString(g_mod, fn);
+    if (!f) {
+        Py_XDECREF(args);
+        return nullptr;
+    }
+    PyObject* r = PyObject_CallObject(f, args);
+    Py_DECREF(f);
+    Py_XDECREF(args);
+    if (!r) {
+        PyErr_Print();
+    }
+    return r;
+}
+
+void set_err(int* error_code, int v)
+{
+    if (error_code) {
+        *error_code = v;
+    }
+}
+
+} // namespace
+
+extern "C" {
+
+/* ---- lifecycle (reference: sirius_initialize / sirius_finalize) ---- */
+
+void sirius_initialize(int const* call_mpi_init, int* error_code)
+{
+    (void)call_mpi_init; /* single-process embedding; MPI handled by jax */
+    std::lock_guard<std::mutex> lk(g_mutex);
+    set_err(error_code, ensure_python() ? 0 : 1);
+}
+
+void sirius_finalize(int const* call_mpi_fin, int* error_code)
+{
+    (void)call_mpi_fin;
+    std::lock_guard<std::mutex> lk(g_mutex);
+    /* keep the interpreter alive if the host owns it */
+    if (g_py_owned && Py_IsInitialized()) {
+        Py_XDECREF(g_mod);
+        g_mod = nullptr;
+        Py_Finalize();
+        g_py_owned = false;
+    }
+    set_err(error_code, 0);
+}
+
+/* ---- context assembly ---- */
+
+void sirius_create_context(void** handler, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    if (!ensure_python()) {
+        set_err(error_code, 1);
+        return;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("create_context", PyTuple_New(0));
+    if (r) {
+        *handler = reinterpret_cast<void*>(PyLong_AsLong(r));
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+void sirius_free_object_handler(void** handler, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("free_handle",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(*handler)));
+    Py_XDECREF(r);
+    *handler = nullptr;
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_import_parameters(void* handler, char const* json_str,
+                              int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("import_parameters",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler),
+                                     json_str));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_set_base_dir(void* handler, char const* path, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_base_dir",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler),
+                                     path));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_set_lattice_vectors(void* handler, double const* a1,
+                                double const* a2, double const* a3,
+                                int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call(
+        "set_lattice_vectors",
+        Py_BuildValue("(l(ddd)(ddd)(ddd))", reinterpret_cast<long>(handler),
+                      a1[0], a1[1], a1[2], a2[0], a2[1], a2[2], a3[0], a3[1],
+                      a3[2]));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_add_atom_type(void* handler, char const* label,
+                          char const* fname, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("add_atom_type",
+                       Py_BuildValue("(lss)", reinterpret_cast<long>(handler),
+                                     label, fname));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_add_atom(void* handler, char const* label, double const* pos,
+                     double const* vector_field, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r;
+    if (vector_field) {
+        r = call("add_atom",
+                 Py_BuildValue("(ls(ddd)(ddd))",
+                               reinterpret_cast<long>(handler), label, pos[0],
+                               pos[1], pos[2], vector_field[0],
+                               vector_field[1], vector_field[2]));
+    } else {
+        r = call("add_atom",
+                 Py_BuildValue("(ls(ddd))", reinterpret_cast<long>(handler),
+                               label, pos[0], pos[1], pos[2]));
+    }
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+/* ---- solve + observables ---- */
+
+void sirius_find_ground_state(void* handler, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("find_ground_state",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_get_energy(void* handler, char const* label, double* value,
+                       int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_energy",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler),
+                                     label));
+    if (r) {
+        *value = PyFloat_AsDouble(r);
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+void sirius_get_num_atoms(void* handler, int* num_atoms, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_num_atoms",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    if (r) {
+        *num_atoms = static_cast<int>(PyLong_AsLong(r));
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+static int fill_mat(PyObject* rows, double* out, int ncol)
+{
+    if (!rows || !PyList_Check(rows)) {
+        return 1;
+    }
+    Py_ssize_t n = PyList_Size(rows);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PyList_GetItem(rows, i);
+        for (int j = 0; j < ncol; j++) {
+            out[i * ncol + j] = PyFloat_AsDouble(PyList_GetItem(row, j));
+        }
+    }
+    return 0;
+}
+
+void sirius_get_forces(void* handler, double* forces, int* error_code)
+{
+    /* forces: [num_atoms][3], Ha/bohr (reference sirius_get_forces) */
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_forces",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    set_err(error_code, r ? fill_mat(r, forces, 3) : 1);
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_get_stress_tensor(void* handler, double* stress, int* error_code)
+{
+    /* stress: [3][3], Ha/bohr^3 */
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_stress",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    set_err(error_code, r ? fill_mat(r, stress, 3) : 1);
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_get_result_json(void* handler, char* buf, int buf_len,
+                            int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_json",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    if (r) {
+        char const* s = PyUnicode_AsUTF8(r);
+        std::snprintf(buf, static_cast<size_t>(buf_len), "%s", s ? s : "");
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+} /* extern "C" */

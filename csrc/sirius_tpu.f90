@@ -1,0 +1,112 @@
+!> Fortran bindings for the C API (reference: src/api/sirius.f90).
+!> Thin ISO_C_BINDING interfaces over libsirius_tpu.so; the handle-based
+!> call flow matches the reference module so QE/CP2K-style host code can
+!> switch by relinking.
+module sirius_tpu
+    use, intrinsic :: iso_c_binding
+    implicit none
+
+    interface
+        subroutine sirius_initialize(call_mpi_init, error_code) &
+                bind(C, name="sirius_initialize")
+            import :: c_int
+            integer(c_int), intent(in) :: call_mpi_init
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_finalize(call_mpi_fin, error_code) &
+                bind(C, name="sirius_finalize")
+            import :: c_int
+            integer(c_int), intent(in) :: call_mpi_fin
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_create_context(handler, error_code) &
+                bind(C, name="sirius_create_context")
+            import :: c_ptr, c_int
+            type(c_ptr), intent(out) :: handler
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_free_object_handler(handler, error_code) &
+                bind(C, name="sirius_free_object_handler")
+            import :: c_ptr, c_int
+            type(c_ptr), intent(inout) :: handler
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_import_parameters(handler, json_str, error_code) &
+                bind(C, name="sirius_import_parameters")
+            import :: c_ptr, c_char, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: json_str
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_base_dir(handler, path, error_code) &
+                bind(C, name="sirius_set_base_dir")
+            import :: c_ptr, c_char, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: path
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_lattice_vectors(handler, a1, a2, a3, &
+                error_code) bind(C, name="sirius_set_lattice_vectors")
+            import :: c_ptr, c_double, c_int
+            type(c_ptr), value :: handler
+            real(c_double), dimension(3), intent(in) :: a1, a2, a3
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_add_atom_type(handler, label, fname, error_code) &
+                bind(C, name="sirius_add_atom_type")
+            import :: c_ptr, c_char, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label, fname
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_add_atom(handler, label, pos, vector_field, &
+                error_code) bind(C, name="sirius_add_atom")
+            import :: c_ptr, c_char, c_double, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            real(c_double), dimension(3), intent(in) :: pos
+            real(c_double), dimension(3), intent(in) :: vector_field
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_find_ground_state(handler, error_code) &
+                bind(C, name="sirius_find_ground_state")
+            import :: c_ptr, c_int
+            type(c_ptr), value :: handler
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_get_energy(handler, label, value, error_code) &
+                bind(C, name="sirius_get_energy")
+            import :: c_ptr, c_char, c_double, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            real(c_double), intent(out) :: value
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_get_forces(handler, forces, error_code) &
+                bind(C, name="sirius_get_forces")
+            import :: c_ptr, c_double, c_int
+            type(c_ptr), value :: handler
+            real(c_double), dimension(*), intent(out) :: forces
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_get_stress_tensor(handler, stress, error_code) &
+                bind(C, name="sirius_get_stress_tensor")
+            import :: c_ptr, c_double, c_int
+            type(c_ptr), value :: handler
+            real(c_double), dimension(9), intent(out) :: stress
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+    end interface
+end module sirius_tpu

@@ -1,0 +1,70 @@
+/* Minimal C host exercising the embedding API end-to-end:
+ * build a context from a verification deck, run SCF, read the energy.
+ * Usage: test_api <deck_dir> <expected_total> <tolerance>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+void sirius_initialize(const int*, int*);
+void sirius_finalize(const int*, int*);
+void sirius_create_context(void**, int*);
+void sirius_free_object_handler(void**, int*);
+void sirius_import_parameters(void*, const char*, int*);
+void sirius_set_base_dir(void*, const char*, int*);
+void sirius_find_ground_state(void*, int*);
+void sirius_get_energy(void*, const char*, double*, int*);
+
+int main(int argc, char** argv)
+{
+    if (argc < 4) {
+        fprintf(stderr, "usage: %s <deck_dir> <expected_total> <tol>\n",
+                argv[0]);
+        return 2;
+    }
+    const char* dir = argv[1];
+    double expect = atof(argv[2]);
+    double tol = atof(argv[3]);
+
+    int err = 0, zero = 0;
+    sirius_initialize(&zero, &err);
+    if (err) { fprintf(stderr, "init failed\n"); return 1; }
+
+    /* read the deck json */
+    char path[1024];
+    snprintf(path, sizeof(path), "%s/sirius.json", dir);
+    FILE* f = fopen(path, "rb");
+    if (!f) { fprintf(stderr, "no deck at %s\n", path); return 1; }
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* json = (char*)malloc((size_t)sz + 1);
+    if (fread(json, 1, (size_t)sz, f) != (size_t)sz) { return 1; }
+    json[sz] = 0;
+    fclose(f);
+
+    void* h = NULL;
+    sirius_create_context(&h, &err);
+    if (err) { fprintf(stderr, "create failed\n"); return 1; }
+    sirius_import_parameters(h, json, &err);
+    if (err) { fprintf(stderr, "import failed\n"); return 1; }
+    sirius_set_base_dir(h, dir, &err);
+    if (err) { fprintf(stderr, "base dir failed\n"); return 1; }
+
+    sirius_find_ground_state(h, &err);
+    if (err) { fprintf(stderr, "scf failed\n"); return 1; }
+
+    double etot = 0.0;
+    sirius_get_energy(h, "total", &etot, &err);
+    if (err) { fprintf(stderr, "get_energy failed\n"); return 1; }
+
+    printf("total = %.10f (expect %.10f)\n", etot, expect);
+    int ok = (etot - expect < tol) && (expect - etot < tol);
+
+    sirius_free_object_handler(&h, &err);
+    sirius_finalize(&zero, &err);
+    free(json);
+    if (!ok) { fprintf(stderr, "ENERGY MISMATCH\n"); return 1; }
+    printf("C API OK\n");
+    return 0;
+}

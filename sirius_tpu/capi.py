@@ -1,0 +1,160 @@
+"""Python side of the C API bridge.
+
+The native shim (csrc/sirius_c_api.cpp) embeds CPython and forwards every
+extern "C" call here. Handles are integer ids into a process-global table;
+each holds the mutable config dict being assembled plus, after
+find_ground_state, the result dict. Mirrors the handle-based flow of the
+reference C API (src/api/sirius_api.cpp: sirius_create_context,
+sirius_import_parameters, sirius_add_atom_type / sirius_add_atom,
+sirius_find_ground_state, sirius_get_energy / sirius_get_forces /
+sirius_get_stress) re-targeted at the jax core.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+_handles: dict[int, dict] = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+def _ensure_cpu_backend() -> None:
+    # embedding hosts (QE/CP2K-style drivers) run f64 physics; force the
+    # CPU backend before any jax backend initialization (see
+    # tests/conftest.py for why the env var is not enough)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+    except Exception:
+        pass  # backend already initialized — keep whatever the host chose
+
+
+def create_context() -> int:
+    with _lock:
+        h = _next_id[0]
+        _next_id[0] += 1
+        _handles[h] = {
+            "cfg": {
+                "parameters": {},
+                "unit_cell": {
+                    "atom_types": [],
+                    "atom_files": {},
+                    "atoms": {},
+                },
+            },
+            "base_dir": ".",
+            "result": None,
+        }
+    return h
+
+
+def free_handle(h: int) -> None:
+    with _lock:
+        _handles.pop(int(h), None)
+
+
+def import_parameters(h: int, json_str: str) -> None:
+    """Deep-merge a reference-format JSON document into the config."""
+    d = json.loads(json_str) if json_str.strip() else {}
+
+    def merge(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(_handles[int(h)]["cfg"], d)
+
+
+def set_base_dir(h: int, path: str) -> None:
+    _handles[int(h)]["base_dir"] = path
+
+
+def set_lattice_vectors(h: int, a1, a2, a3) -> None:
+    _handles[int(h)]["cfg"]["unit_cell"]["lattice_vectors"] = [
+        list(a1), list(a2), list(a3)
+    ]
+    _handles[int(h)]["cfg"]["unit_cell"]["lattice_vectors_scale"] = 1.0
+
+
+def add_atom_type(h: int, label: str, fname: str) -> None:
+    uc = _handles[int(h)]["cfg"]["unit_cell"]
+    if label not in uc["atom_types"]:
+        uc["atom_types"].append(label)
+    uc["atom_files"][label] = fname
+    uc["atoms"].setdefault(label, [])
+
+
+def add_atom(h: int, label: str, pos, vector_field=None) -> None:
+    uc = _handles[int(h)]["cfg"]["unit_cell"]
+    if label not in uc["atom_types"]:
+        uc["atom_types"].append(label)
+    entry = list(pos) + (list(vector_field) if vector_field else [])
+    uc["atoms"].setdefault(label, []).append(entry)
+
+
+def find_ground_state(h: int) -> None:
+    _ensure_cpu_backend()
+    from sirius_tpu.config.schema import load_config
+
+    st = _handles[int(h)]
+    cfg = load_config(st["cfg"])
+    if cfg.parameters.electronic_structure_method == "full_potential_lapwlo":
+        from sirius_tpu.lapw.scf_fp import run_scf_fp
+
+        st["result"] = run_scf_fp(cfg, st["base_dir"])
+    else:
+        from sirius_tpu.dft.scf import run_scf
+
+        st["result"] = run_scf(cfg, base_dir=st["base_dir"])
+
+
+def _result(h: int) -> dict:
+    r = _handles[int(h)]["result"]
+    if r is None:
+        raise RuntimeError("find_ground_state has not been called")
+    return r
+
+
+def get_energy(h: int, label: str) -> float:
+    e = _result(h)["energy"]
+    # reference label aliases (sirius_api.cpp sirius_get_energy)
+    aliases = {"total": "total", "free": "free", "evalsum": "eval_sum",
+               "exc": "exc", "vxc": "vxc", "vha": "vha", "veff": "veff",
+               "kin": "kin", "ewald": "ewald", "entropy": "entropy_sum",
+               "demet": "entropy_sum"}
+    return float(e[aliases.get(label, label)])
+
+
+def get_num_atoms(h: int) -> int:
+    uc = _handles[int(h)]["cfg"]["unit_cell"]
+    return sum(len(v) for v in uc["atoms"].values())
+
+
+def get_forces(h: int) -> list:
+    r = _result(h)
+    if "forces" not in r:
+        raise RuntimeError("forces were not computed (control.print_forces)")
+    return [list(row) for row in r["forces"]]
+
+
+def get_stress(h: int) -> list:
+    r = _result(h)
+    if "stress" not in r:
+        raise RuntimeError("stress was not computed (control.print_stress)")
+    return [list(row) for row in r["stress"]]
+
+
+def get_scalar(h: int, name: str) -> float:
+    r = _result(h)
+    v = r[name]
+    return float(v)
+
+
+def get_json(h: int) -> str:
+    return json.dumps(_result(h), default=float)

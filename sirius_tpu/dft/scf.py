@@ -675,6 +675,10 @@ def run_scf_from_file(
     if cfg.parameters.electronic_structure_method == "full_potential_lapwlo":
         # FP-LAPW branch (reference dft_ground_state FP path); tasks other
         # than the ground state are PP-PW-only for now
+        if task not in ("ground_state_new", "ground_state"):
+            raise NotImplementedError(
+                f"FP-LAPW task '{task}' not supported yet (ground state only)"
+            )
         from sirius_tpu.lapw.scf_fp import run_scf_fp
 
         result = run_scf_fp(cfg, base_dir)

@@ -188,6 +188,13 @@ def diagonalize_fv(H, O, nev: int):
     overlap is numerically singular (near-dependent lo + APW sets)."""
     nev = min(nev, H.shape[0])
     try:
+        # guard the fast path against QUIET ill-conditioning (near-dependent
+        # lo+APW sets pass Cholesky but poison the spectrum with ghosts):
+        # diag(L) spans ~sqrt of O's spectrum — cheap rank proxy
+        L = np.linalg.cholesky(O)
+        d = np.real(np.diag(L))
+        if d.min() < 1e-7 * d.max():
+            raise np.linalg.LinAlgError("overlap nearly singular")
         from scipy.linalg import eigh as seigh
 
         e, v = seigh(H, O, subset_by_index=[0, nev - 1])

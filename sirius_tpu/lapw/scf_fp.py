@@ -163,10 +163,10 @@ class FpContext:
         nat = len(self.positions)
         types = list(dict.fromkeys(self.labels))
         tid = {lab: i for i, lab in enumerate(types)}
-        # nearest neighbour over periodic images
-        img = np.array(
-            [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1) for k in (-1, 0, 1)]
-        )
+        # nearest neighbour over periodic images (+-2 covers moderately
+        # skewed / non-reduced cells; the reference does a radius search)
+        rng2 = (-2, -1, 0, 1, 2)
+        img = np.array([[i, j, k] for i in rng2 for j in rng2 for k in rng2])
         nn_d = np.full(nat, np.inf)
         nn_j = np.zeros(nat, dtype=int)
         for ia in range(nat):
@@ -249,11 +249,25 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
     if not sp.core_states():
         return np.zeros_like(sp.r), 0.0, 0.0
     e_floor = -0.6 * sp.zn**2 - 10.0  # brackets 1s for any Z
-    # extended grid: MT grid + exponential continuation to rinf
+    # extended grid + potential tail alpha/r + beta matching the ELECTRONIC
+    # part's value and derivative at R (reference
+    # atom_symmetry_class.cpp:781-810 generate_core_charge_density)
     r_mt = sp.r
-    r_ext = np.geomspace(r_mt[-1], max(sp.rinf, r_mt[-1] * 2), 400)[1:]
+    R = r_mt[-1]
+    ext = []
+    x = R
+    dx = r_mt[-1] - r_mt[-2]
+    while x < 30.0 + sp.zn / 4.0:
+        x += dx
+        ext.append(x)
+        dx *= 1.025
+    r_ext = np.asarray(ext)
     r = np.concatenate([r_mt, r_ext])
-    v = np.concatenate([v_sph, v_sph[-1] * r_mt[-1] / r_ext])
+    svmt = v_sph + sp.zn / r_mt  # electronic part (nucleus removed)
+    dsv = (svmt[-1] - svmt[-3]) / (r_mt[-1] - r_mt[-3])
+    alpha = -(R * R * dsv + sp.zn)
+    beta = svmt[-1] - (sp.zn + alpha) / R
+    v = np.concatenate([v_sph, alpha / r_ext + beta])
     rho = np.zeros_like(r)
     esum = 0.0
     for (nql, l, occ) in sp.core_states():
@@ -561,28 +575,20 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                     )
                 )
         if nm:
-            rho_r_new = np.zeros(ctx.dims)
-            mag_r_new = np.zeros(ctx.dims)
-            for ik in range(len(ctx.kpoints)):
-                ngk = len(ctx.gkmill[ik])
-                i0 = np.mod(ctx.gkmill[ik][:, 0], ctx.dims[0])
-                i1 = np.mod(ctx.gkmill[ik][:, 1], ctx.dims[1])
-                i2 = np.mod(ctx.gkmill[ik][:, 2], ctx.dims[2])
-                spin_rho = []
-                for ispn in range(ns):
-                    Csv = C_k[ik][:ngk] @ U_k[ik][ispn]
-                    acc = np.zeros(ctx.dims)
-                    for j in range(nev):
-                        f = ctx.kweights[ik] * occ_np[ik, ispn, j]
-                        if f < 1e-12:
-                            continue
-                        box = np.zeros(ctx.dims, dtype=np.complex128)
-                        box[i0, i1, i2] = Csv[:, j]
-                        psi = np.fft.ifftn(box) * n / np.sqrt(ctx.omega)
-                        acc += f * np.abs(psi) ** 2
-                    spin_rho.append(acc)
-                rho_r_new += spin_rho[0] + spin_rho[1]
-                mag_r_new += spin_rho[0] - spin_rho[1]
+            spin_rho = []
+            for ispn in range(ns):
+                C_sv = [
+                    C_k[ik][: len(ctx.gkmill[ik])] @ U_k[ik][ispn]
+                    for ik in range(len(ctx.kpoints))
+                ]
+                spin_rho.append(
+                    interstitial_density_box(
+                        C_sv, ctx.gkmill, occ_np[:, ispn, :], ctx.kweights,
+                        ctx.dims, ctx.omega,
+                    )
+                )
+            rho_r_new = spin_rho[0] + spin_rho[1]
+            mag_r_new = spin_rho[0] - spin_rho[1]
         else:
             rho_r_new = interstitial_density_box(
                 C_k, ctx.gkmill, occ_np[:, 0, :], ctx.kweights, ctx.dims,
@@ -618,9 +624,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 mag_mt_new = symmetrize_mt(
                     mag_mt_new, ctx.sym.ops, ctx.lmax_rho
                 )
-                box = np.zeros(ctx.dims, dtype=np.complex128).ravel()
-                box[ctx.gvec.fft_index] = mag_ig_new
-                mag_r_new = np.real(np.fft.ifftn(box.reshape(ctx.dims)) * n)
+                mag_r_new = ctx.g2r(mag_ig_new)
 
         sq4pi_ = np.sqrt(4.0 * np.pi)
         mt_charge = sum(
