@@ -1,0 +1,167 @@
+"""Variable-cell stabilized quasi-Newton (VC-SQNM) structure optimizer.
+
+Reference: src/vcsqnm/sqnm.hpp (stabilized QN on the significant-subspace
+Hessian, arXiv:2206.07339) and src/vcsqnm/periodic_optimizer.hpp (the
+combined atomic + lattice coordinate transform). Host-side numpy — the
+optimizer drives SCF runs; there is nothing to jit.
+
+Conventions: positions/forces are CARTESIAN [nat, 3] row vectors; the
+lattice matrix has ROWS a_i (the repo-wide convention — the reference's
+Eigen column matrices are transposed here). Stress is the symmetric
+Cartesian stress tensor; forces are -dE/dr (forces, not gradients).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _HistoryList:
+    """Sliding history with consecutive-difference lists (reference
+    historylist.hpp): difflist[:, i] = v_{i+1} - v_i over kept entries."""
+
+    def __init__(self, nhist_max: int):
+        self.nhist_max = nhist_max
+        self.entries: list[np.ndarray] = []
+
+    def add(self, v: np.ndarray) -> int:
+        self.entries.append(np.asarray(v, float).copy())
+        if len(self.entries) > self.nhist_max + 1:
+            self.entries.pop(0)
+        return len(self.entries) - 1
+
+    @property
+    def difflist(self) -> np.ndarray:
+        d = [
+            self.entries[i + 1] - self.entries[i]
+            for i in range(len(self.entries) - 1)
+        ]
+        return np.stack(d, axis=1) if d else np.zeros((0, 0))
+
+
+class SQNM:
+    """Stabilized quasi-Newton minimizer (reference sqnm.hpp:100-240)."""
+
+    def __init__(self, ndim: int, nhist_max: int, alpha: float,
+                 alpha0: float = 1e-2, eps_subsp: float = 1e-4):
+        self.ndim = ndim
+        self.nhist_max = min(nhist_max, ndim)
+        self.alpha = alpha
+        self.alpha0 = alpha0
+        self.eps_subsp = eps_subsp
+        self.xlist = _HistoryList(self.nhist_max)
+        self.flist = _HistoryList(self.nhist_max)
+        self.prev_f = 0.0
+        self.prev_df = None
+        self.dir = None
+        self.h_eval_min = 1.0
+
+    def step(self, x: np.ndarray, f_of_x: float, df_dx: np.ndarray) -> np.ndarray:
+        """Displacement to ADD to x (df_dx is the gradient, = -force)."""
+        x = np.asarray(x, float)
+        df = np.asarray(df_dx, float)
+        if np.linalg.norm(df) <= 1e-13:
+            return np.zeros(self.ndim)
+        nhist = self.xlist.add(x)
+        self.flist.add(df)
+        if nhist == 0:
+            self.dir = -self.alpha * df
+        else:
+            gain = (f_of_x - self.prev_f) / (
+                0.5 * float(self.dir @ self.prev_df)
+            )
+            if gain < 0.5:
+                self.alpha = max(self.alpha * 0.65, self.alpha0)
+            elif gain > 1.05:
+                self.alpha *= 1.05
+
+            dx = self.xlist.difflist  # [ndim, nhist]
+            dg = self.flist.difflist
+            norms = np.linalg.norm(dx, axis=0)
+            dxn = dx / norms[None, :]
+            S = dxn.T @ dxn
+            s_eval, s_evec = np.linalg.eigh(S)
+            keep = s_eval / s_eval[-1] > self.eps_subsp
+            s_eval, s_evec = s_eval[keep], s_evec[:, keep]
+            dr_sub = (dxn @ s_evec) / np.sqrt(s_eval)[None, :]
+            df_sub = ((dg / norms[None, :]) @ s_evec) / np.sqrt(s_eval)[None, :]
+            h = 0.5 * (df_sub.T @ dr_sub + dr_sub.T @ df_sub)
+            h_eval, h_evec_s = np.linalg.eigh(h)
+            h_evec = dr_sub @ h_evec_s  # eq. 15
+            # residues (eq. 20) stabilize the eigenvalues (eq. 18)
+            res = np.linalg.norm(
+                df_sub @ h_evec_s - h_evec * h_eval[None, :], axis=0
+            )
+            h_eval = np.sqrt(h_eval**2 + res**2)
+            self.h_eval_min = float(h_eval[0])
+            # gradient split: steepest descent outside the subspace,
+            # Newton inside (eqs. 16, 21)
+            proj = h_evec.T @ df
+            d = self.alpha * (df - h_evec @ proj)
+            d += h_evec @ (proj / h_eval)
+            self.dir = -d
+        self.prev_f = float(f_of_x)
+        self.prev_df = df
+        return self.dir
+
+    def lower_bound(self) -> float:
+        if self.prev_df is None:
+            return 0.0
+        return self.prev_f - 0.5 * float(
+            self.prev_df @ self.prev_df
+        ) / max(self.h_eval_min, 1e-12)
+
+
+class PeriodicOptimizer:
+    """Fixed- or variable-cell relaxation driver (reference
+    periodic_optimizer.hpp). For vc mode the lattice rides along as 9
+    extra coordinates scaled by w*sqrt(nat)/|a_i| so atomic and cell
+    degrees of freedom share one Hessian model."""
+
+    def __init__(self, nat: int, lattice: np.ndarray | None = None,
+                 initial_step_size: float = 1.0, nhist_max: int = 10,
+                 lattice_weight: float = 2.0, alpha0: float = 1e-2,
+                 eps_subsp: float = 1e-4):
+        self.nat = nat
+        self.vc = lattice is not None
+        ndim = 3 * nat + (9 if self.vc else 0)
+        self.opt = SQNM(ndim, nhist_max, initial_step_size, alpha0, eps_subsp)
+        if self.vc:
+            a0 = np.asarray(lattice, float)  # rows a_i
+            self.a0 = a0
+            self.a0_inv = np.linalg.inv(a0)
+            t = np.diag(
+                lattice_weight * np.sqrt(nat) / np.linalg.norm(a0, axis=1)
+            )
+            self.T = t
+            self.T_inv = np.linalg.inv(t)
+
+    def step_fixed(self, r: np.ndarray, energy: float, forces: np.ndarray):
+        """r [nat,3] cartesian -> improved positions."""
+        d = self.opt.step(r.ravel(), energy, -np.asarray(forces).ravel())
+        return r + d.reshape(self.nat, 3)
+
+    def step_vc(self, r: np.ndarray, energy: float, forces: np.ndarray,
+                lattice: np.ndarray, stress: np.ndarray):
+        """(positions [nat,3], lattice rows [3,3]) -> improved pair.
+
+        q = r a^-1 a0 (fractional-consistent transformed coordinates),
+        dq = -f a0^-1 a; lattice block scaled by T; lattice gradient
+        da = -det(a) a^-1 stress (row convention transpose of the
+        reference's calc_lattice_derivatices)."""
+        a = np.asarray(lattice, float)
+        f = np.asarray(forces, float)
+        q = r @ np.linalg.inv(a) @ self.a0
+        dq = -f @ self.a0_inv @ a
+        a_t = self.T @ a
+        da = -(np.linalg.det(a) * np.linalg.inv(a).T @ np.asarray(stress, float))
+        da_t = self.T_inv @ da
+        xall = np.concatenate([q.ravel(), a_t.ravel()])
+        dall = np.concatenate([dq.ravel(), da_t.ravel()])
+        step = self.opt.step(xall, energy, dall)
+        xall = xall + step
+        q = xall[: 3 * self.nat].reshape(self.nat, 3)
+        a_t = xall[3 * self.nat :].reshape(3, 3)
+        a_new = self.T_inv @ a_t
+        r_new = q @ self.a0_inv @ a_new
+        return r_new, a_new

@@ -111,27 +111,43 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
         ])
         enu_l.append(e0)
     lo = []
+    from sirius_tpu.lapw.radial_solver import radial_dme_chain
+
     for d in sp.lo:
         l = d.l
-        e0 = d.basis[0].enu
-        if d.basis[0].auto:
-            n = d.basis[0].n if d.basis[0].n > 0 else l + 1
-            e0 = find_enu(r, v_sph, l, n, rel)
-        u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v_sph, l, e0, rel)
-        # zero-boundary combination WITHOUT division: (c1, c2) = (udR, -uR)
-        # gives f(R) = 0 exactly and stays stable when the auto enu lands on
-        # a bound state with u(R) -> 0 (then f ~ udR * u, pure u — correct)
-        c1, c2 = udR, -uR
-        if abs(c1) + abs(c2) < 1e-14:
-            c1, c2 = 1.0, 0.0
-        f = c1 * u + c2 * ud
-        hf = e0 * f + c2 * u  # (T+Vs)(c1 u + c2 ud) = E f + c2 u
+        # per-entry (enu, dme) solutions; entries at the same resolved
+        # energy share one derivative chain
+        chains: dict = {}
+        comps = []  # (u, hu, uR, upR) per basis entry
+        for be in d.basis:
+            e0 = be.enu
+            if be.auto:
+                n = be.n if be.n > 0 else l + 1
+                e0 = find_enu(r, v_sph, l, n, rel)
+            key = round(e0, 12)
+            need = be.dme
+            if key not in chains or len(chains[key]) <= need:
+                chains[key] = radial_dme_chain(r, v_sph, l, e0, rel, max_m=need)
+            comps.append(chains[key][be.dme])
+        if len(comps) != 2:
+            raise NotImplementedError(
+                f"lo with {len(comps)} radial components (2 supported)"
+            )
+        (ua, hua, uaR, uapR), (ub, hub, ubR, ubpR) = comps
+        # zero-boundary combination WITHOUT division: (ca, cb) = (ubR, -uaR)
+        # gives f(R) = 0 exactly and stays stable when an auto enu lands on
+        # a bound state with u(R) -> 0
+        ca, cb = ubR, -uaR
+        if abs(ca) + abs(cb) < 1e-14:
+            ca, cb = 1.0, 0.0
+        f = ca * ua + cb * ub
+        hf = ca * hua + cb * hub
         nrm = np.sqrt(rint(f * f * r * r, r))
         lo.append(
             MtRadial(
                 l=l, f=f / nrm, hf=hf / nrm,
-                fR=(c1 * uR + c2 * udR) / nrm,
-                fpR=(c1 * upR + c2 * udpR) / nrm,
+                fR=(ca * uaR + cb * ubR) / nrm,
+                fpR=(ca * uapR + cb * ubpR) / nrm,
             )
         )
     return AtomRadialBasis(lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l)

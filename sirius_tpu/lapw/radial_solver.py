@@ -82,21 +82,34 @@ def integrate_outward(r, veff, l: int, E: float, rel: str = "none",
     a_qp = v2 - E + ll2 / (m2 * r2 * r2)  # q' = a_qp p - q/r (- sources)
     inv_r = 1.0 / r2
     kh = rel in ("koelling_harmon", "iora")
-    if mderiv == 1:
-        src_p = ALPHA * ALPHA * q_prev if kh else np.zeros_like(v2)
-        src_q = -(1.0 + ll2 * ALPHA * ALPHA / (2.0 * m2 * m2 * r2 * r2)) * p_prev if kh \
-            else -p_prev
+    if mderiv >= 1:
+        # (h - E) u_m = m u_{m-1}: the m-th energy derivative solves the
+        # same system with the (m-1)-th solution as source, scaled by m
+        # (reference radial_solver.hpp solve() m=1,2 branches)
+        src_p = mderiv * ALPHA * ALPHA * q_prev if kh else np.zeros_like(v2)
+        src_q = -mderiv * (1.0 + ll2 * ALPHA * ALPHA / (2.0 * m2 * m2 * r2 * r2)) * p_prev if kh \
+            else -mderiv * p_prev
     p = np.empty(n)
     q = np.empty(n)
-    p[0] = r[0] ** (l + 1)
-    q[0] = 0.5 * l * r[0] ** l
+    # starting values at r0: relativistic indicial behavior r^b near the
+    # nuclear singularity for the scalar-relativistic cases (reference
+    # radial_solver.hpp:535-543), non-relativistic r^{l+1} otherwise
+    zn_eff = max(-v2[0] * r[0], 0.0)
+    if rel in ("koelling_harmon", "zora", "iora") and zn_eff > 1e-8:
+        a0 = l * (l + 1) + 1.0 - (ALPHA * zn_eff) ** 2
+        b0 = 0.5 * (1.0 + np.sqrt(1.0 + 4.0 * a0))
+        p[0] = r[0] ** b0
+        q[0] = p[0] * (b0 - 1.0) / (zn_eff * ALPHA * ALPHA)
+    else:
+        p[0] = r[0] ** (l + 1)
+        q[0] = 0.5 * l * r[0] ** l
     yp, yq = p[0], q[0]
     nodes = 0
 
     def f(i2, pp, qq):
         dp = a_pq[i2] * qq + pp * inv_r[i2]
         dq = a_qp[i2] * pp - qq * inv_r[i2]
-        if mderiv == 1:
+        if mderiv >= 1:
             dp += src_p[i2]
             dq += src_q[i2]
         return dp, dq
@@ -324,6 +337,45 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
     P, Q = _cut_forbidden_tail(P, r, veff, l, E, q=Q)
     nrm = np.sqrt(rint(P * P + Q * Q, r))
     return E, (P / nrm) / r, (Q / nrm) / r
+
+
+def radial_dme_chain(r, veff, l: int, E: float, rel: str = "none",
+                     max_m: int = 1):
+    """Energy-derivative chain u^(0..max_m) at E with spherical-Hamiltonian
+    images: h u_m = E u_m + m u_{m-1}. u_0 normalized; u_1 orthogonalized
+    to u_0 (the images stay consistent: (h-E)(u_1 - c u_0) = u_0). Returns
+    list of (u, hu, uR, upR)."""
+    v2 = _with_midpoints(r, veff)
+    R = r[-1]
+
+    def boundary(p, q, Ecur):
+        m = float(_mass(rel, Ecur, np.asarray([veff[-1]]))[0])
+        kh_extra = ALPHA * ALPHA * q[-1] if rel in ("koelling_harmon", "iora") else 0.0
+        return p[-1] / R, (2.0 * m * q[-1] + kh_extra) / R
+
+    p0, q0, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
+    nrm = np.sqrt(rint(p0 * p0, r))
+    p0, q0 = p0 / nrm, q0 / nrm
+    u0R, u0pR = boundary(p0, q0, E)
+    chain = [[p0, q0]]
+    out = [(p0 / r, E * (p0 / r), u0R, u0pR)]
+    for m in range(1, max_m + 1):
+        pp, qp = chain[m - 1]
+        pm, qm, _ = integrate_outward(
+            r, veff, l, E, rel,
+            p_prev=_with_midpoints(r, pp), q_prev=_with_midpoints(r, qp),
+            mderiv=m, v2=v2,
+        )
+        if m == 1:
+            ov = rint(p0 * pm, r)
+            pm = pm - ov * p0
+            qm = qm - ov * q0
+        umR, umpR = boundary(pm, qm, E)
+        chain.append([pm, qm])
+        um = pm / r
+        hum = E * um + m * (chain[m - 1][0] / r)
+        out.append((um, hum, umR, umpR))
+    return out
 
 
 def radial_solution_with_edot(r, veff, l: int, E: float, rel: str = "none"):

@@ -268,6 +268,17 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
     alpha = -(R * R * dsv + sp.zn)
     beta = svmt[-1] - (sp.zn + alpha) / R
     v = np.concatenate([v_sph, alpha / r_ext + beta])
+    # deep-core eigenvalues need better than the basis grid's RK4 step:
+    # solve on the midpoint-refined grid (error / 16; reference uses an
+    # adaptive RK8 integrator, radial_solver.hpp gsl rk8pd)
+    from sirius_tpu.lapw.radial_solver import _with_midpoints
+
+    r_fine = np.empty(2 * len(r) - 1)
+    r_fine[0::2] = r
+    r_fine[1::2] = 0.5 * (r[:-1] + r[1:])
+    v_fine = _with_midpoints(r, v)
+    nmt_fine = 2 * len(r_mt) - 1
+    r, v = r_fine, v_fine
     rho = np.zeros_like(r)
     esum = 0.0
     for (nql, l, occ) in sp.core_states():

@@ -1,0 +1,96 @@
+"""Blocked conjugate-gradient solver with per-column convergence locking.
+
+Reference: src/multi_cg/multi_cg.hpp:40-180 (sirius::cg::multi_cg) — the
+backend of the reference's sirius_linear_solver C-API call
+(src/api/sirius_api.cpp:6101) used by Quantum ESPRESSO's DFPT/phonon code.
+
+TPU-first redesign: the reference moves converged columns to the front of
+the block (repack) to shrink the GEMMs — a dynamic shape. Under jit we
+keep the block FIXED and mask converged columns out of the updates
+instead: every iteration is the same static program, the while_loop exits
+when the mask empties. The per-column quantities (rho, alpha) ride along
+as [nrhs] vectors.
+
+The Sternheimer operator for linear response,
+  A_i = H - eps_i S + alpha_pv sum_occ S |psi><psi| S,
+is provided as a closure factory; its projector term regularizes the
+near-singular occupied subspace exactly like the reference's
+Linear_response_operator (alpha_pv from QE).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def multi_cg(apply_a, x0, b, apply_p=None, tol: float = 1e-3,
+             maxiter: int = 100):
+    """Solve A x_i = b_i for a block of right-hand sides.
+
+    apply_a(X): [m, nrhs] -> [m, nrhs] (each column through its own
+    operator — closures may index per-column shifts); apply_p optional
+    preconditioner. Returns (X, niter, res_norms [nrhs]).
+
+    Masked-fixed-shape analog of the reference multi_cg (repack -> mask)."""
+    if apply_p is None:
+        def apply_p(r):
+            return r
+
+    nrhs = b.shape[1]
+
+    def dots(a_, b_):
+        return jnp.sum(jnp.conj(a_) * b_, axis=0)
+
+    r0 = b - apply_a(x0)
+
+    def cond(state):
+        it, _, _, _, _, _, active = state
+        return jnp.logical_and(it < maxiter, jnp.any(active))
+
+    def body(state):
+        it, x, r, u, rho_old, first, active = state
+        c = apply_p(r)
+        rho = dots(c, r)
+        active = jnp.logical_and(active, jnp.abs(rho) > tol * tol)
+        beta = jnp.where(
+            first | ~active,
+            jnp.zeros_like(rho),
+            rho / jnp.where(jnp.abs(rho_old) > 0, rho_old, 1.0),
+        )
+        u = c + beta[None, :] * u
+        ac = apply_a(u)
+        sigma = dots(u, ac)
+        alpha = jnp.where(
+            active,
+            rho / jnp.where(jnp.abs(sigma) > 0, sigma, 1.0),
+            jnp.zeros_like(rho),
+        )
+        x = x + alpha[None, :] * u
+        r = r - alpha[None, :] * ac
+        return (it + 1, x, r, u, rho, jnp.zeros((), bool), active)
+
+    state = (
+        jnp.zeros((), jnp.int32), x0, r0, jnp.zeros_like(b),
+        jnp.zeros(nrhs, b.dtype),
+        jnp.ones((), bool), jnp.ones(nrhs, bool),
+    )
+    it, x, r, _, _, _, _ = lax.while_loop(cond, body, state)
+    return x, it, jnp.sqrt(jnp.abs(dots(r, r)))
+
+
+def sternheimer_operator(apply_h_s, psi_occ, eps, alpha_pv: float):
+    """A(X)[:, i] = (H - eps_i S) X[:, i] + alpha_pv S Psi (Psi^H S X).
+
+    apply_h_s(X) -> (HX, SX) columnwise; psi_occ [m, nocc] unperturbed
+    occupied states; eps [nrhs] band energies of the columns being solved
+    (reference lr::Linear_response_operator, multi_cg.hpp:320-420)."""
+    _, s_psi = apply_h_s(psi_occ)
+
+    def apply_a(x):
+        hx, sx = apply_h_s(x)
+        proj = s_psi @ (jnp.conj(s_psi).T @ x)
+        return hx - eps[None, :] * sx + alpha_pv * proj
+
+    return apply_a

@@ -1,0 +1,92 @@
+"""Blocked CG with convergence locking vs dense solves.
+
+Covers the reference multi_cg semantics (src/multi_cg/multi_cg.hpp):
+per-column operators (band-energy shifts), preconditioning, and the
+Sternheimer projector regularization of the occupied subspace."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from sirius_tpu.solvers.multi_cg import multi_cg, sternheimer_operator
+
+
+def _hpd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    return a @ a.conj().T + n * np.eye(n)
+
+
+def test_multi_cg_matches_dense_solve():
+    n, nrhs = 60, 5
+    A = _hpd(n)
+    rng = np.random.default_rng(1)
+    B = rng.standard_normal((n, nrhs)) + 1j * rng.standard_normal((n, nrhs))
+
+    x, niter, res = multi_cg(
+        lambda X: jnp.asarray(A) @ X, jnp.zeros_like(jnp.asarray(B)),
+        jnp.asarray(B), tol=1e-10, maxiter=500,
+    )
+    ref = np.linalg.solve(A, B)
+    assert np.abs(np.asarray(x) - ref).max() < 1e-6
+    assert int(niter) < 500
+
+
+def test_multi_cg_per_column_shifts_and_precond():
+    """Each column solves (A - eps_i I) x = b with its own shift, the
+    diagonal preconditioner accelerates; all columns converge."""
+    n, nrhs = 80, 4
+    A = _hpd(n, seed=2)
+    eps = np.array([0.5, 1.0, 1.5, 2.0])
+    rng = np.random.default_rng(3)
+    B = rng.standard_normal((n, nrhs)) + 1j * rng.standard_normal((n, nrhs))
+    d = np.real(np.diag(A))
+
+    def apply_a(X):
+        return jnp.asarray(A) @ X - jnp.asarray(eps)[None, :] * X
+
+    def apply_p(R):
+        return R / (jnp.asarray(d)[:, None] - jnp.asarray(eps)[None, :])
+
+    x, _, _ = multi_cg(
+        apply_a, jnp.zeros_like(jnp.asarray(B)), jnp.asarray(B),
+        apply_p=apply_p, tol=1e-10, maxiter=800,
+    )
+    for i in range(nrhs):
+        ref = np.linalg.solve(A - eps[i] * np.eye(n), B[:, i])
+        assert np.abs(np.asarray(x[:, i]) - ref).max() < 1e-6, i
+
+
+def test_sternheimer_projector_regularizes_singular_shift():
+    """(H - eps_occ) alone is singular at an occupied eigenvalue; the
+    alpha_pv S|psi><psi|S projector makes the system solvable on the
+    orthogonal complement (the DFPT use case)."""
+    n = 50
+    H = _hpd(n, seed=4)
+    w, v = np.linalg.eigh(H)
+    nocc = 4
+    psi = v[:, :nocc]
+    eps = w[:nocc]
+    alpha_pv = 2.0 * (w[-1] - w[0])
+
+    def apply_h_s(X):
+        return jnp.asarray(H) @ X, X
+
+    apply_a = sternheimer_operator(
+        apply_h_s, jnp.asarray(psi), jnp.asarray(eps), alpha_pv
+    )
+    # right-hand side orthogonal to the occupied subspace (as in DFPT:
+    # b = -P_c dV psi)
+    rng = np.random.default_rng(5)
+    B = rng.standard_normal((n, nocc)) + 1j * rng.standard_normal((n, nocc))
+    B = B - psi @ (psi.conj().T @ B)
+
+    x, niter, res = multi_cg(
+        apply_a, jnp.zeros_like(jnp.asarray(B)), jnp.asarray(B),
+        tol=1e-11, maxiter=1000,
+    )
+    x = np.asarray(x)
+    # the solution solves the projected equation on the complement
+    Adense = [H - eps[i] * np.eye(n) for i in range(nocc)]
+    for i in range(nocc):
+        lhs = Adense[i] @ x[:, i] + alpha_pv * (psi @ (psi.conj().T @ x[:, i]))
+        assert np.abs(lhs - B[:, i]).max() < 1e-5, i

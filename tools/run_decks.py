@@ -29,11 +29,15 @@ VER = "/root/reference/verification"
 # decks wired for the current feature set (PP-PW; collinear + non-collinear)
 WIRED = [
     "test01",  # SrVO3 US LDA 2x2x2
+    "test02",  # He FP-LAPW molecule LDA-VWN
     "test04",  # LiF PAW LDA 4x4x4
     "test08",  # Si US LDA Gamma
     "test09",  # Ni non-collinear PBE 4x4x4
     "test15",  # LiF PAW LDA Gamma
+    "test19",  # Fe bcc FP-LAPW collinear LDA-PW 4x4x4
+    "test20",  # H2O FP-LAPW molecule LDA-VWN
     "test23",  # H atom NC LDA 2x2x2
+    "test31",  # H atom FP-LAPW KH 2x2x2
 ]
 
 
