@@ -33,10 +33,23 @@ class Mixer:
         glen2: np.ndarray | None = None,
         num_components: int = 1,
         extra_len: int = 0,
+        omega: float | None = None,
     ):
-        """num_components: G-sized components (charge first, then e.g.
-        magnetization); extra_len: trailing flat entries mixed with plain l2
-        (occupation matrices etc., reference mixer tuple of function spaces).
+        """num_components: G-sized components (charge first, then
+        magnetization); extra_len: trailing flat entries (occupation/density
+        matrices, PAW) that are mixed passively — the reference gives them a
+        ZERO inner product (mixer_functions.cpp density_function_property
+        "do not contribute to mixing"), so they never steer the Anderson/
+        Broyden coefficients or the rms.
+
+        Channel metrics (reference mixer_functions.cpp): the plain inner
+        product of two periodic functions is the real-space integral
+        int f g dr = Omega sum_G f*(G) g(G); with use_hartree the CHARGE
+        channel instead gets 4 pi sum_{G!=0} f* g / G^2. Both the metric and
+        the rms normalization (inner / Omega per channel,
+        mixer.hpp update_rms) need Omega — pass it with glen2. Without glen2
+        (FP-LAPW mixed vector) a plain unweighted l2 over the whole vector
+        is used.
         """
         if cfg.type not in self.KNOWN:
             raise ValueError(
@@ -46,15 +59,30 @@ class Mixer:
         self.max_history = cfg.max_history
         self.kind = "anderson" if cfg.type == "broyden1" else cfg.type
         self.weight = None
-        if cfg.use_hartree and glen2 is not None:
-            # Hartree metric on the charge component; plain l2 on the others
-            # (magnetization), matching the reference mixer_functions.cpp
-            g2 = np.where(glen2 > 1e-12, glen2, np.inf)
-            w = 4.0 * np.pi / g2
+        self.rms_weight = None  # per-coefficient weight of the normalized rms
+        if glen2 is not None:
+            if omega is None:
+                raise ValueError("Mixer needs omega together with glen2")
+            ng = len(glen2)
+            if cfg.use_hartree:
+                g2 = np.where(glen2 > 1e-12, glen2, np.inf)
+                w_charge = 4.0 * np.pi / g2
+                # normalized by size = 1/Omega (mixer_functions.cpp
+                # periodic_function_property_modified) -> MULTIPLIED by Omega
+                rms_charge = omega * w_charge
+            else:
+                w_charge = np.full(ng, omega)
+                rms_charge = np.ones(ng)
             self.weight = np.concatenate(
-                [w]
-                + [np.ones_like(w)] * (num_components - 1)
-                + [np.ones(extra_len)]
+                [w_charge]
+                + [np.full(ng, omega)] * (num_components - 1)
+                + [np.zeros(extra_len)]
+            )
+            # plain channels: inner = Omega sum|d_G|^2, size = Omega -> 1/coeff
+            self.rms_weight = np.concatenate(
+                [rms_charge]
+                + [np.ones(ng)] * (num_components - 1)
+                + [np.zeros(extra_len)]
             )
         self._x: list[np.ndarray] = []  # input history
         self._f: list[np.ndarray] = []  # residual history f = x_out - x_in
@@ -64,8 +92,14 @@ class Mixer:
         return float(np.real(np.sum(w * np.conj(a) * b)))
 
     def rms(self, x_in: np.ndarray, x_out: np.ndarray) -> float:
+        """sqrt of the sum over channels of inner(d,d)/size (reference
+        mixer.hpp update_rms with normalize=true)."""
         d = x_out - x_in
-        return float(np.sqrt(max(self._inner(d, d), 0.0) / d.size))
+        if self.rms_weight is None:
+            return float(np.sqrt(np.real(np.vdot(d, d)) / d.size))
+        return float(
+            np.sqrt(max(np.real(np.sum(self.rms_weight * np.conj(d) * d)), 0.0))
+        )
 
     def _mix_anderson(self, x_in, f):
         # type-II Anderson: minimize ||f - sum g_j df_j|| in the metric,

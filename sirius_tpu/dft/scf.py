@@ -205,6 +205,7 @@ def run_scf(
         cfg.mixer, ctx.gvec.glen2,
         num_components=2 if polarized else 1,
         extra_len=om_size + paw_size,
+        omega=ctx.unit_cell.omega,
     )
     # constant device tables, uploaded once (not per iteration); the full-
     # precision projector stack feeds the density-matrix accumulation
@@ -312,7 +313,7 @@ def run_scf(
     evals = np.zeros((nk, ns, nb))
     pr = pi = None  # batched-path device-resident (re, im) wave functions
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
-    etot_history, rms_history = [], []
+    etot_history, rms_history, mag_history = [], [], []
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
     num_iter_done = 0
     itsol = cfg.iterative_solver
@@ -530,6 +531,10 @@ def run_scf(
         # etot_hist; verified against verification/test23 and test01 outputs)
         etot_history.append(e_total + float(entropy_sum))
         rms_history.append(rms)
+        if polarized:
+            # per-iteration total moment (reference prints magnetisation
+            # each SCF step); recorded from the OUTPUT density pre-mix
+            mag_history.append(float(np.real(mag_new[0]) * ctx.unit_cell.omega))
         num_iter_done = it + 1
 
         de = abs(e_total - e_prev) if e_prev is not None else np.inf
@@ -571,6 +576,7 @@ def run_scf(
         "rho_min": float(rho_r.min()),
         "etot_history": etot_history,
         "rms_history": rms_history,
+        "mag_history": mag_history,
         "scf_time": time.time() - t0,
         "energy": {
             "total": e_total,

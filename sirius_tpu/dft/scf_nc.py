@@ -126,7 +126,9 @@ def run_scf_nc(
     psi = _initial_spinors(ctx)
 
     pot = generate_potential_nc(ctx, rho_g, xc, mvec_g)
-    mixer = Mixer(cfg.mixer, ctx.gvec.glen2, num_components=4)
+    mixer = Mixer(
+        cfg.mixer, ctx.gvec.glen2, num_components=4, omega=ctx.unit_cell.omega
+    )
     ng = ctx.gvec.num_gvec
 
     do_symmetrize = (

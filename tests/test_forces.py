@@ -23,7 +23,7 @@ def _run(positions, ultrasoft):
         use_symmetry=False,
         positions=positions,
         extra_params={
-            "density_tol": 1e-10,
+            "density_tol": 5e-9,
             "energy_tol": 1e-11,
             "num_dft_iter": 60,
         },

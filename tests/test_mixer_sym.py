@@ -80,7 +80,7 @@ def test_anderson_stable_hartree_metric_finite_at_g0():
     cfg = MixerConfig(
         type="anderson_stable", beta=0.5, max_history=6, use_hartree=True
     )
-    mixer = Mixer(cfg, glen2=glen2)
+    mixer = Mixer(cfg, glen2=glen2, omega=1.0)
     a = rng.standard_normal((n, n)) * 0.4 / np.sqrt(n)
     b = rng.standard_normal(n)
     x = np.zeros(n)
@@ -96,9 +96,11 @@ def test_anderson_stable_hartree_metric_finite_at_g0():
 def test_mixer_hartree_metric_weights_charge_only():
     glen2 = np.array([0.0, 1.0, 4.0])
     cfg = MixerConfig(type="anderson", beta=0.5, max_history=4, use_hartree=True)
-    m = Mixer(cfg, glen2=glen2, num_components=2, extra_len=1)
-    # G=0 gets infinite-G guard (weight 0 via inf), second component + extras l2
-    np.testing.assert_allclose(m.weight, [0.0, 4 * np.pi, np.pi, 1, 1, 1, 1])
+    m = Mixer(cfg, glen2=glen2, num_components=2, extra_len=1, omega=2.0)
+    # G=0 gets infinite-G guard (weight 0 via inf); magnetization channel gets
+    # the plain real-space metric Omega*sum_G; extras are passive (zero weight,
+    # reference mixer_functions.cpp density_function_property)
+    np.testing.assert_allclose(m.weight, [0.0, 4 * np.pi, np.pi, 2, 2, 2, 0])
 
 
 def test_mixer_unknown_type_rejected():

@@ -21,7 +21,7 @@ def _run(strain=None):
         ultrasoft=False,
         use_symmetry=False,
         positions=np.array([[0.0, 0, 0], [0.26, 0.24, 0.25]]),
-        extra_params={"density_tol": 1e-10, "energy_tol": 1e-11, "num_dft_iter": 60},
+        extra_params={"density_tol": 5e-9, "energy_tol": 1e-11, "num_dft_iter": 60},
     )
     if strain is not None:
         # rebuild the context with a strained lattice
@@ -75,7 +75,7 @@ def _run_us(strain=None):
         ultrasoft=True,
         use_symmetry=False,
         positions=np.array([[0.0, 0, 0], [0.26, 0.24, 0.25]]),
-        extra_params={"density_tol": 1e-10, "energy_tol": 1e-11, "num_dft_iter": 60},
+        extra_params={"density_tol": 5e-9, "energy_tol": 1e-11, "num_dft_iter": 60},
     )
     if strain is not None:
         uc = ctx.unit_cell

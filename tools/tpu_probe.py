@@ -9,10 +9,64 @@ Usage: python tools/tpu_probe.py <stage>
   stage 3: eigh c64 (78x78, the Rayleigh-Ritz size) inside jit
   stage 4: one davidson step (scan length=1) on bench shapes
   stage 5: full 20-step davidson_kset on bench shapes
+
+       python tools/tpu_probe.py --record <tier>   (tier: full | micro | hpsi)
+  Runs the matching bench.py tier on the accelerator and, on success,
+  appends {tier, value, platform, label, timestamp} to TPU_RECORDED.json at
+  the repo root — bench.py reports that as a recorded tier if the compile
+  service is wedged at round-end capture time.
 """
 
+import json
+import os
 import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def record(tier: str) -> int:
+    """Run a bench tier on the default (accelerator) platform in a
+    subprocess and persist its timing for bench.py's recorded fallback."""
+    import subprocess
+
+    tmo = {"full": 900, "micro": 300, "hpsi": 600}.get(tier, 600)
+    r = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--tier", f"{tier}:default"],
+            capture_output=True, text=True, timeout=tmo,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"record {tier}: timed out after {tmo}s")
+        return 1
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    if r.returncode != 0 or not lines:
+        print(f"record {tier}: failed rc={r.returncode}\n{r.stderr[-500:]}")
+        return 1
+    res = json.loads(lines[-1])
+    plat = "tpu" if " on tpu" in res["metric"] else res["metric"].rsplit(" on ", 1)[-1]
+    if plat != "tpu":
+        print(f"record {tier}: ran on '{plat}', not recording (tpu only)")
+        return 1
+    path = os.path.join(REPO, "TPU_RECORDED.json")
+    entries = []
+    if os.path.exists(path):
+        try:
+            entries = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            entries = []
+    entries.append({
+        "tier": tier,
+        "value": res["value"],
+        "platform": "tpu",
+        "label": res["metric"].rsplit(" on ", 1)[0],
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    })
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=1)
+    print(f"record {tier}: {res['value']} s/iter recorded to TPU_RECORDED.json")
+    return 0
 
 
 def main(stage: int) -> None:
@@ -102,4 +156,6 @@ def main(stage: int) -> None:
 
 
 if __name__ == "__main__":
+    if sys.argv[1] == "--record":
+        sys.exit(record(sys.argv[2]))
     main(int(sys.argv[1]))
