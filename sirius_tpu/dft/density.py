@@ -110,11 +110,13 @@ def atomic_sphere_radii(uc, rmax: float = 2.0) -> np.ndarray:
 def initial_magnetization_vec_g(ctx: SimulationContext) -> np.ndarray:
     """[3, ng] initial (mx, my, mz) from per-atom starting moment vectors.
 
-    Each atom contributes its full moment in a compact normalized bump
-    w(R, x) = (1 - (x/R)^2) e^{x/R} / (3.18866 R^3) inside an atomic sphere
-    (reference density.cpp initial magnetization weight) — a LOCALIZED seed;
-    a diffuse seed (free-atom profile scaled by m/z) was observed to collapse
-    bcc Fe into the paramagnetic basin."""
+    Two seeds, selected by settings.smooth_initial_mag exactly like the
+    reference (density.cpp initial_density_pseudo):
+      - smooth: per-atom Gaussian exp(-G^2/(4 alpha)), alpha = 4 — sharply
+        peaked at the atom (~1.4 m e/a0^3 at r=0), which is what gives the
+        first iteration a strong exchange splitting on localized shells;
+      - default: compact normalized bump w(R, x) = (1 - (x/R)^2) e^{x/R} /
+        (3.18866 R^3) inside an atomic sphere."""
     from sirius_tpu.core.radial import sbessel_integral
 
     uc = ctx.unit_cell
@@ -122,17 +124,22 @@ def initial_magnetization_vec_g(ctx: SimulationContext) -> np.ndarray:
     out = np.zeros((3, gv.num_gvec), dtype=np.complex128)
     if not np.any(np.abs(uc.moments) > 1e-12):
         return out
+    smooth = bool(ctx.cfg.settings.smooth_initial_mag)
     rad = atomic_sphere_radii(uc)
     qshell = np.sqrt(gv.shell_g2)
     for ia in range(uc.num_atoms):
         mvec = uc.moments[ia]
         if np.all(np.abs(mvec) < 1e-12):
             continue
-        r = np.linspace(1e-8, rad[ia], 400)
-        w = (1 - (r / rad[ia]) ** 2) * np.exp(r / rad[ia]) / (
-            3.1886583903476735 * rad[ia] ** 3
-        )
-        ff = sbessel_integral(r, 4.0 * np.pi * w, 0, qshell, m=2)[gv.shell_idx]
+        if smooth:
+            alpha = 4.0
+            ff = np.exp(-gv.shell_g2 / (4.0 * alpha))[gv.shell_idx]
+        else:
+            r = np.linspace(1e-8, rad[ia], 400)
+            w = (1 - (r / rad[ia]) ** 2) * np.exp(r / rad[ia]) / (
+                3.1886583903476735 * rad[ia] ** 3
+            )
+            ff = sbessel_integral(r, 4.0 * np.pi * w, 0, qshell, m=2)[gv.shell_idx]
         phase = np.exp(-2j * np.pi * (gv.millers @ uc.positions[ia]))
         for i in range(3):
             if abs(mvec[i]) > 1e-12:

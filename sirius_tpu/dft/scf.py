@@ -47,21 +47,30 @@ def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
 
 
 def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
-    """LCAO + random-fill initial wave functions [nk, nspin, nb, ngk]."""
+    """LCAO + random-fill initial trial vectors [nk, nspin, nbig, ngk].
+
+    nbig = max(num_bands, num_atomic_orbitals): the FULL atomic-orbital set
+    must enter the initial subspace even when it exceeds num_bands —
+    truncating it drops whole orbital characters (e.g. 3 of the 5 Fe 3d
+    orbitals with nb=10, nao=13) and the band solver then locks on higher
+    eigenpairs it can reach instead (reference initialize_subspace.hpp:27
+    always spans all atomic wfs and keeps the lowest nb Ritz vectors;
+    run_scf performs that rotation at the first iteration)."""
     nk = ctx.gkvec.num_kpoints
     nb = ctx.num_bands
     ngk = ctx.gkvec.ngk_max
     ao = atomic_orbitals(ctx.unit_cell, ctx.gkvec, ctx.cfg.parameters.gk_cutoff + 1e-9)
+    nao = ao.shape[1]
+    nbig = max(nb, nao)
     rng = np.random.default_rng(42)
-    psi = np.zeros((nk, ctx.num_spins, nb, ngk), dtype=np.complex128)
+    psi = np.zeros((nk, ctx.num_spins, nbig, ngk), dtype=np.complex128)
     for ik in range(nk):
-        nao = ao.shape[1]
-        base = np.zeros((nb, ngk), dtype=np.complex128)
-        n0 = min(nao, nb)
+        base = np.zeros((nbig, ngk), dtype=np.complex128)
+        n0 = min(nao, nbig)
         if n0:
             base[:n0] = ao[ik, :n0]
-        if nb > n0:
-            r = rng.standard_normal((nb - n0, ngk)) + 1j * rng.standard_normal((nb - n0, ngk))
+        if nbig > n0:
+            r = rng.standard_normal((nbig - n0, ngk)) + 1j * rng.standard_normal((nbig - n0, ngk))
             # damp high-G components so random vectors are smooth-ish
             damp = 1.0 / (1.0 + ctx.gkvec.kinetic()[ik])
             base[n0:] = r * damp
@@ -71,6 +80,16 @@ def _initial_subspace(ctx: SimulationContext) -> jnp.ndarray:
     # host numpy, NOT a device array: complex must never be device-resident
     # outside jit (parallel/batched.py real-boundary contract)
     return psi
+
+
+def _subspace_rotate_host(x, hx, sx, nb):
+    """Host wrapper over the shared solvers.davidson.subspace_rotate
+    (serial debug path only)."""
+    from sirius_tpu.solvers.davidson import subspace_rotate
+
+    return np.asarray(
+        subspace_rotate(jnp.asarray(x), jnp.asarray(hx), jnp.asarray(sx), nb)
+    )
 
 
 def run_scf(
@@ -197,8 +216,12 @@ def run_scf(
         else 0.0
     )
     pot = generate_potential(ctx, rho_g, xc, mag_g)
+    psi_big = None
     if psi is None:
-        psi = _initial_subspace(ctx)
+        # full atomic-orbital block (nbig >= nb); rotated down to the lowest
+        # nb Ritz vectors at the first band solve, once the screened D of
+        # the initial potential exists (reference initialize_subspace)
+        psi_big = _initial_subspace(ctx)
     om_size = 0 if hub is None else ns * hub.num_hub_total * hub.num_hub_total
     paw_size = 0 if paw is None else paw.dm_size()
     mixer = Mixer(
@@ -336,6 +359,31 @@ def run_scf(
         v0 = float(np.real(pot.veff_g[0]))
         with profile("scf::band_solve"):
             if serial_bands:
+                if psi is None and psi_big is not None:
+                    # first iteration from a fresh LCAO block: rotate the
+                    # full atomic-orbital subspace down to nb Ritz vectors
+                    # (reference initialize_subspace)
+                    psi0 = np.zeros(
+                        (nk, ns, nb, ctx.gkvec.ngk_max), dtype=np.complex128
+                    )
+                    for ik in range(nk):
+                        for ispn in range(ns):
+                            params = hk_params(
+                                ik, pot.veff_r_coarse[ispn], d_by_spin[ispn],
+                                wf_dtype,
+                                vhub_s=None if vhub is None else vhub[ispn],
+                            )
+                            xb = psi_big[ik, ispn] * np.asarray(ctx.gkvec.mask[ik])
+                            hx, sx = apply_h_s(params, jnp.asarray(xb, dtype=wf_dtype))
+                            psi0[ik, ispn] = _subspace_rotate_host(
+                                xb,
+                                np.asarray(hx, dtype=np.complex128),
+                                np.asarray(sx, dtype=np.complex128),
+                                nb,
+                            )
+                    counters["num_loc_op_applied"] += nk * ns * psi_big.shape[2]
+                    psi = psi0
+                    psi_big = None
                 new_psi = []
                 for ik in range(nk):
                     per_spin = []
@@ -379,6 +427,20 @@ def run_scf(
                     wf_dtype,
                 )
                 rdt = real_dtype_of(wf_dtype)
+                if pr is None and psi is None and psi_big is not None:
+                    # first iteration from a fresh LCAO block: rotate the
+                    # full atomic-orbital subspace down to the lowest nb
+                    # Ritz vectors (reference initialize_subspace.hpp:279)
+                    from sirius_tpu.parallel.batched import (
+                        initialize_subspace_kset,
+                    )
+
+                    pb_re, pb_im = split_cplx(psi_big, rdt)
+                    pr, pi = initialize_subspace_kset(
+                        ps, jnp.asarray(pb_re), jnp.asarray(pb_im), nb
+                    )
+                    counters["num_loc_op_applied"] += nk * ns * psi_big.shape[2]
+                    psi_big = None
                 if pr is None or pr.dtype != np.dtype(rdt):
                     # initial entry or precision switch; psi may be stale
                     # (None) if the previous iterations kept the pair only
@@ -554,10 +616,17 @@ def run_scf(
             break
 
     # --- final report ---
-    if psi is None:
+    if psi is None and pr is not None:
         from sirius_tpu.parallel.batched import join_cplx
 
         psi = join_cplx(pr, pi)
+    elif psi is None:
+        # num_dft_iter == 0: no band solve ran, so the LCAO block was never
+        # rotated; report its first nb rows for shape-valid output ONLY —
+        # this truncation must not be persisted as a warm start
+        psi = psi_big[:, :, :nb] if psi_big is not None else None
+        keep_state = False
+        save_to = None
     occ_np = np.asarray(occ)
     band_gap = _band_gap(evals, occ_np, ctx)
     rho_r = rho_real_space(ctx, rho_g)

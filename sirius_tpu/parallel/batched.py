@@ -208,6 +208,56 @@ def make_hkset_params(
     )
 
 
+@partial(jax.jit, static_argnames=("nb",))
+def initialize_subspace_kset(params: HkSetParams, psi_re, psi_im, nb: int):
+    """LCAO subspace initialization for the whole (k, spin) set: one H/S
+    application to the full atomic-orbital block (+ random tail), one
+    generalized Rayleigh-Ritz, keep the lowest nb Ritz vectors (reference
+    initialize_subspace.hpp:27 per-k, :279 kset driver). The input block is
+    [nk, ns, nbig, ngk] with nbig >= nb; truncating atomic orbitals to nb
+    BEFORE the rotation loses orbital characters and mis-seeds the band
+    solver (Fe 3d, test03).
+
+    Returns (psi_re, psi_im) [nk, ns, nb, ngk]."""
+    from sirius_tpu.solvers.davidson import subspace_rotate
+
+    psi = _cplx(psi_re, psi_im)
+    has_hub = params.hub_re is not None
+
+    def one_k(ekin, mask, fft_index, beta_re, beta_im, hub_re_k, hub_im_k, psi_k):
+        def one_spin(veff_s, dion_s, vhub_re_s, vhub_im_s, x0):
+            pk = HkParams(
+                veff_r=veff_s,
+                ekin=ekin,
+                mask=mask,
+                fft_index=fft_index,
+                beta=_cplx(beta_re, beta_im),
+                dion=dion_s,
+                qmat=params.qmat,
+                hub=None if hub_re_k is None else _cplx(hub_re_k, hub_im_k),
+                vhub=None if vhub_re_s is None else _cplx(vhub_re_s, vhub_im_s),
+            )
+            x = x0 * mask
+            hx, sx = apply_h_s(pk, x)
+            return subspace_rotate(x, hx, sx, nb, mask=mask)
+
+        return jax.vmap(
+            one_spin,
+            in_axes=(0, 0, None if not has_hub else 0,
+                     None if not has_hub else 0, 0),
+        )(params.veff_r, params.dion, params.vhub_re, params.vhub_im, psi_k)
+
+    hub_ax = 0 if has_hub else None
+    x = jax.vmap(
+        one_k,
+        in_axes=(0, 0, 0, 0, 0, hub_ax, hub_ax, 0),
+    )(
+        params.ekin, params.mask, params.fft_index, params.beta_re,
+        params.beta_im, params.hub_re, params.hub_im, psi,
+    )
+    return jnp.real(x), jnp.imag(x)
+
+
 @partial(jax.jit, static_argnames=("num_steps",))
 def davidson_kset(
     params: HkSetParams, psi_re, psi_im, num_steps: int = 20, res_tol: float = 1e-6

@@ -62,6 +62,22 @@ def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6)
     return e[:nev], c[:, :nev]
 
 
+def subspace_rotate(x, hx, sx, nb: int, mask=None):
+    """Lowest-nb Ritz vectors of the trial block x given carried H x / S x:
+    shared by the LCAO initialize-subspace paths (serial host and batched
+    device); pure jnp, callable inside or outside jit."""
+    hsub = x.conj() @ hx.T
+    ssub = x.conj() @ sx.T
+    hsub = 0.5 * (hsub + hsub.conj().T)
+    ssub = 0.5 * (ssub + ssub.conj().T)
+    _, c = _rayleigh_ritz(hsub, ssub, nb)
+    xn = c.T @ x
+    if mask is not None:
+        xn = xn * mask
+    nrm = jnp.real(jnp.sum(xn.conj() * (c.T @ sx), axis=1))
+    return xn / jnp.sqrt(jnp.maximum(nrm, 1e-30))[:, None]
+
+
 def _precondition(r: jax.Array, h_diag: jax.Array, o_diag: jax.Array, eval_: jax.Array):
     """Reference apply_preconditioner (residuals_aux.cu): smooth Teter-like."""
     p = h_diag[None, :] - eval_[:, None] * o_diag[None, :]

@@ -57,8 +57,8 @@ def test_batched_kset_path_matches_serial():
         return synthetic_silicon_context(
             gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(2, 2, 2), num_bands=8,
             ultrasoft=True, use_symmetry=False,
-            extra_params={"num_dft_iter": 12, "density_tol": 1e-8,
-                          "energy_tol": 1e-9},
+            extra_params={"num_dft_iter": 25, "density_tol": 5e-9,
+                          "energy_tol": 1e-10},
         )
 
     ctx_a = make()
@@ -68,8 +68,10 @@ def test_batched_kset_path_matches_serial():
     assert res_b["converged"] and res_s["converged"]
     for term in ("total", "eval_sum", "vha", "exc"):
         assert abs(res_b["energy"][term] - res_s["energy"][term]) < 1e-7, term
+    # the topmost empty bands converge to the residual tolerance only
+    # (reference empty_states_tolerance): compare occupied + low empties
     np.testing.assert_allclose(
-        np.asarray(res_b["band_energies"]),
-        np.asarray(res_s["band_energies"]),
+        np.asarray(res_b["band_energies"])[..., :6],
+        np.asarray(res_s["band_energies"])[..., :6],
         atol=1e-6,
     )
