@@ -288,6 +288,226 @@ void sirius_get_stress_tensor(void* handler, double* stress, int* error_code)
     PyGILState_Release(st);
 }
 
+/* ---- per-step flow (QE embedding contract: host-owned SCF loop with
+ * host-side mixing; reference sirius_initialize_context,
+ * sirius_find_eigen_states, sirius_generate_density,
+ * sirius_generate_effective_potential, sirius_set/get_pw_coeffs,
+ * sirius_get_wave_functions, src/api/sirius_api.cpp) ---- */
+
+static void call_void_h(const char* fn, void* handler, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call(fn, Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_initialize_context(void* handler, int* error_code)
+{
+    call_void_h("initialize_context", handler, error_code);
+}
+
+void sirius_find_eigen_states(void* handler, int* error_code)
+{
+    call_void_h("find_eigen_states", handler, error_code);
+}
+
+void sirius_find_band_occupancies(void* handler, int* error_code)
+{
+    call_void_h("find_band_occupancies", handler, error_code);
+}
+
+void sirius_generate_density(void* handler, int* error_code)
+{
+    call_void_h("generate_density", handler, error_code);
+}
+
+void sirius_generate_effective_potential(void* handler, int* error_code)
+{
+    call_void_h("generate_effective_potential", handler, error_code);
+}
+
+static void get_int_h(const char* fn, void* handler, int* value,
+                      int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call(fn, Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    if (r) {
+        *value = static_cast<int>(PyLong_AsLong(r));
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+void sirius_get_num_gvec(void* handler, int* num_gvec, int* error_code)
+{
+    get_int_h("get_num_gvec", handler, num_gvec, error_code);
+}
+
+void sirius_get_num_bands(void* handler, int* num_bands, int* error_code)
+{
+    get_int_h("get_num_bands", handler, num_bands, error_code);
+}
+
+void sirius_get_num_kpoints(void* handler, int* num_kpoints, int* error_code)
+{
+    get_int_h("get_num_kpoints", handler, num_kpoints, error_code);
+}
+
+void sirius_get_num_spins(void* handler, int* num_spins, int* error_code)
+{
+    get_int_h("get_num_spins", handler, num_spins, error_code);
+}
+
+void sirius_get_max_num_gkvec(void* handler, int* ngk_max, int* error_code)
+{
+    /* leading dimension of the padded [num_bands][ngk_max] wavefunction
+     * slabs returned by sirius_get_wave_functions */
+    get_int_h("get_max_num_gkvec", handler, ngk_max, error_code);
+}
+
+void sirius_get_energy_fermi(void* handler, double* efermi, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_efermi",
+                       Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    if (r) {
+        *efermi = PyFloat_AsDouble(r);
+        Py_DECREF(r);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    PyGILState_Release(st);
+}
+
+void sirius_get_pw_coeffs(void* handler, char const* label,
+                          double* pw_coeffs /* complex: 2*num_gvec doubles */,
+                          int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_pw_coeffs_bytes",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler),
+                                     label));
+    if (r && PyBytes_Check(r)) {
+        std::memcpy(pw_coeffs, PyBytes_AsString(r),
+                    static_cast<size_t>(PyBytes_Size(r)));
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_set_pw_coeffs(void* handler, char const* label,
+                          double const* pw_coeffs, int const* num_gvec,
+                          int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* buf = PyBytes_FromStringAndSize(
+        reinterpret_cast<char const*>(pw_coeffs),
+        static_cast<Py_ssize_t>(*num_gvec) * 16);
+    PyObject* r = call("set_pw_coeffs_bytes",
+                       Py_BuildValue("(lsO)", reinterpret_cast<long>(handler),
+                                     label, buf));
+    Py_XDECREF(buf);
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_get_band_energies(void* handler, int const* ik, int const* ispn,
+                              double* energies, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_band_energies",
+                       Py_BuildValue("(lii)", reinterpret_cast<long>(handler),
+                                     *ik, *ispn));
+    if (r && PyList_Check(r)) {
+        Py_ssize_t n = PyList_Size(r);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            energies[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+        }
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_set_band_occupancies(void* handler, int const* ik,
+                                 int const* ispn, double const* occ,
+                                 int const* num_bands, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* lst = PyList_New(*num_bands);
+    for (int i = 0; i < *num_bands; i++) {
+        PyList_SetItem(lst, i, PyFloat_FromDouble(occ[i]));
+    }
+    PyObject* r = call("set_band_occupancies",
+                       Py_BuildValue("(liiO)", reinterpret_cast<long>(handler),
+                                     *ik, *ispn, lst));
+    Py_XDECREF(lst);
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_get_band_occupancies(void* handler, int const* ik,
+                                 int const* ispn, double* occ,
+                                 int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_band_occupancies",
+                       Py_BuildValue("(lii)", reinterpret_cast<long>(handler),
+                                     *ik, *ispn));
+    if (r && PyList_Check(r)) {
+        Py_ssize_t n = PyList_Size(r);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            occ[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+        }
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_get_wave_functions(void* handler, int const* ik, int const* ispn,
+                               double* psi /* complex [nb][ngk_max] */,
+                               int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_wave_functions_bytes",
+                       Py_BuildValue("(lii)", reinterpret_cast<long>(handler),
+                                     *ik, *ispn));
+    if (r && PyBytes_Check(r)) {
+        std::memcpy(psi, PyBytes_AsString(r),
+                    static_cast<size_t>(PyBytes_Size(r)));
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
 void sirius_get_result_json(void* handler, char* buf, int buf_len,
                             int* error_code)
 {

@@ -1,0 +1,110 @@
+/* C host driving the PER-STEP embedding flow (QE contract): the host owns
+ * the SCF loop and the mixer; the library exposes find_eigen_states /
+ * generate_density / generate_effective_potential / set|get_pw_coeffs as
+ * separate calls (reference src/api/sirius_api.cpp per-step entries).
+ * Converges test23-class decks with plain host-side linear mixing and
+ * checks the energy against the expected single-shot value.
+ * Usage: test_api_steps <deck_dir> <expected_total> <tolerance>
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+void sirius_initialize(const int*, int*);
+void sirius_finalize(const int*, int*);
+void sirius_create_context(void**, int*);
+void sirius_free_object_handler(void**, int*);
+void sirius_import_parameters(void*, const char*, int*);
+void sirius_set_base_dir(void*, const char*, int*);
+void sirius_initialize_context(void*, int*);
+void sirius_find_eigen_states(void*, int*);
+void sirius_find_band_occupancies(void*, int*);
+void sirius_generate_density(void*, int*);
+void sirius_generate_effective_potential(void*, int*);
+void sirius_get_num_gvec(void*, int*, int*);
+void sirius_get_pw_coeffs(void*, const char*, double*, int*);
+void sirius_set_pw_coeffs(void*, const char*, const double*, const int*, int*);
+void sirius_get_energy(void*, const char*, double*, int*);
+
+int main(int argc, char** argv)
+{
+    if (argc < 4) {
+        fprintf(stderr, "usage: %s <deck_dir> <expected_total> <tol>\n", argv[0]);
+        return 2;
+    }
+    const char* dir = argv[1];
+    double expect = atof(argv[2]);
+    double tol = atof(argv[3]);
+
+    int err = 0, zero = 0;
+    sirius_initialize(&zero, &err);
+    if (err) { fprintf(stderr, "init failed\n"); return 1; }
+
+    char path[1024];
+    snprintf(path, sizeof(path), "%s/sirius.json", dir);
+    FILE* f = fopen(path, "rb");
+    if (!f) { fprintf(stderr, "no deck at %s\n", path); return 1; }
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* json = (char*)malloc((size_t)sz + 1);
+    if (fread(json, 1, (size_t)sz, f) != (size_t)sz) { return 1; }
+    json[sz] = 0;
+    fclose(f);
+
+    void* h = NULL;
+    sirius_create_context(&h, &err);
+    sirius_import_parameters(h, json, &err);
+    sirius_set_base_dir(h, dir, &err);
+    sirius_initialize_context(h, &err);
+    if (err) { fprintf(stderr, "initialize_context failed\n"); return 1; }
+
+    int ng = 0;
+    sirius_get_num_gvec(h, &ng, &err);
+    if (err || ng <= 0) { fprintf(stderr, "num_gvec failed\n"); return 1; }
+    double* rho_in = (double*)malloc((size_t)ng * 16);
+    double* rho_out = (double*)malloc((size_t)ng * 16);
+
+    const double beta = 0.7;
+    double e_prev = 0.0, e = 0.0;
+    int it;
+    for (it = 0; it < 30; it++) {
+        sirius_find_eigen_states(h, &err);
+        if (err) { fprintf(stderr, "eigen states failed\n"); return 1; }
+        sirius_find_band_occupancies(h, &err);
+        if (err) { fprintf(stderr, "occupancies failed\n"); return 1; }
+        sirius_generate_density(h, &err);
+        if (err) { fprintf(stderr, "density failed\n"); return 1; }
+
+        /* host-side linear mixing of the PW density */
+        sirius_get_pw_coeffs(h, "rho", rho_in, &err);
+        sirius_get_pw_coeffs(h, "rho_out", rho_out, &err);
+        if (err) { fprintf(stderr, "get_pw_coeffs failed\n"); return 1; }
+        for (int i = 0; i < 2 * ng; i++) {
+            rho_in[i] += beta * (rho_out[i] - rho_in[i]);
+        }
+        sirius_set_pw_coeffs(h, "rho", rho_in, &ng, &err);
+        if (err) { fprintf(stderr, "set_pw_coeffs failed\n"); return 1; }
+
+        sirius_generate_effective_potential(h, &err);
+        sirius_get_energy(h, "total", &e, &err);
+        if (err) { fprintf(stderr, "energy failed\n"); return 1; }
+        printf("step %2d  E = %.10f\n", it + 1, e);
+        if (it > 0 && fabs(e - e_prev) < 1e-9) { break; }
+        e_prev = e;
+    }
+
+    double de = fabs(e - expect);
+    printf("host-driven SCF: %d steps, E = %.10f (expect %.7f, dE %.2e)\n",
+           it + 1, e, expect, de);
+    if (de > tol) { fprintf(stderr, "ENERGY MISMATCH\n"); return 1; }
+
+    sirius_free_object_handler(&h, &err);
+    sirius_finalize(&zero, &err);
+    printf("C API STEPS OK\n");
+    free(rho_in);
+    free(rho_out);
+    free(json);
+    return 0;
+}

@@ -121,14 +121,120 @@ def _result(h: int) -> dict:
     return r
 
 
+_ENERGY_ALIASES = {"total": "total", "free": "free", "evalsum": "eval_sum",
+                   "exc": "exc", "vxc": "vxc", "vha": "vha", "veff": "veff",
+                   "kin": "kin", "ewald": "ewald", "entropy": "entropy_sum",
+                   "demet": "entropy_sum"}
+
+
 def get_energy(h: int, label: str) -> float:
-    e = _result(h)["energy"]
-    # reference label aliases (sirius_api.cpp sirius_get_energy)
-    aliases = {"total": "total", "free": "free", "evalsum": "eval_sum",
-               "exc": "exc", "vxc": "vxc", "vha": "vha", "veff": "veff",
-               "kin": "kin", "ewald": "ewald", "entropy": "entropy_sum",
-               "demet": "entropy_sum"}
-    return float(e[aliases.get(label, label)])
+    st = _handles[int(h)]
+    key = _ENERGY_ALIASES.get(label, label)
+    # per-step flow: energies come from the live stepper state
+    if st.get("stepper") is not None and st["result"] is None:
+        return float(st["stepper"].total_energy()[key])
+    return float(_result(h)["energy"][key])
+
+
+# ---- per-step flow (reference QE embedding contract, SURVEY §3.5):
+# sirius_initialize_context + find_eigen_states / generate_density /
+# generate_effective_potential / set|get_pw_coeffs as separate calls with
+# host-side mixing (src/api/sirius_api.cpp per-step entries) ----
+
+
+def initialize_context(h: int) -> None:
+    _ensure_cpu_backend()
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.stepper import GroundStateStepper
+
+    st = _handles[int(h)]
+    cfg = load_config(st["cfg"])
+    st["stepper"] = GroundStateStepper(cfg, st["base_dir"])
+
+
+def _stepper(h: int):
+    s = _handles[int(h)].get("stepper")
+    if s is None:
+        raise RuntimeError("initialize_context has not been called")
+    return s
+
+
+def find_eigen_states(h: int) -> None:
+    _stepper(h).find_eigen_states()
+
+
+def find_band_occupancies(h: int) -> None:
+    _stepper(h).find_band_occupancies()
+
+
+def generate_density(h: int) -> None:
+    _stepper(h).generate_density()
+
+
+def generate_effective_potential(h: int) -> None:
+    _stepper(h).generate_effective_potential()
+
+
+def get_num_gvec(h: int) -> int:
+    return int(_stepper(h).ctx.gvec.num_gvec)
+
+
+def get_max_num_gkvec(h: int) -> int:
+    """ngk_max: leading dimension of the padded wave-function slabs (a C
+    host must size get_wave_functions buffers as nb * ngk_max)."""
+    return int(_stepper(h).ctx.gkvec.ngk_max)
+
+
+def get_num_bands(h: int) -> int:
+    return int(_stepper(h).nb)
+
+
+def get_num_kpoints(h: int) -> int:
+    return int(_stepper(h).nk)
+
+
+def get_num_spins(h: int) -> int:
+    return int(_stepper(h).ns)
+
+
+def get_efermi(h: int) -> float:
+    return float(_stepper(h).efermi)
+
+
+def get_pw_coeffs_bytes(h: int, label: str) -> bytes:
+    """complex128 PW coefficients as raw bytes (C side memcpy's them)."""
+    import numpy as np
+
+    return np.ascontiguousarray(
+        _stepper(h).get_pw_coeffs(label), dtype=np.complex128
+    ).tobytes()
+
+
+def set_pw_coeffs_bytes(h: int, label: str, buf: bytes) -> None:
+    import numpy as np
+
+    _stepper(h).set_pw_coeffs(label, np.frombuffer(buf, dtype=np.complex128))
+
+
+def get_band_energies(h: int, ik: int, ispn: int) -> list:
+    return [float(x) for x in _stepper(h).get_band_energies(int(ik), int(ispn))]
+
+
+def set_band_occupancies(h: int, ik: int, ispn: int, occ: list) -> None:
+    _stepper(h).set_band_occupancies(int(ik), int(ispn), occ)
+
+
+def get_band_occupancies(h: int, ik: int, ispn: int) -> list:
+    return [float(x) for x in _stepper(h).occ[int(ik), int(ispn)]]
+
+
+def get_wave_functions_bytes(h: int, ik: int, ispn: int) -> bytes:
+    import numpy as np
+
+    return np.ascontiguousarray(
+        _stepper(h).get_wave_functions(int(ik), int(ispn)),
+        dtype=np.complex128,
+    ).tobytes()
 
 
 def get_num_atoms(h: int) -> int:

@@ -1,9 +1,10 @@
 """FFT grid: box dimensioning and batched G<->r transforms.
 
 Replaces the reference's fft::Grid / SpFFT wrappers (src/core/fft/fft3d_grid.hpp,
-fft.hpp:29-95). On TPU there is no slab decomposition: single-chip transforms
-are whole-box batched jnp.fft calls (XLA lowers these well); the distributed
-path lives in sirius_tpu.parallel (shard_map + all_to_all over the "g" axis).
+fft.hpp:29-95). Single-chip transforms are whole-box batched jnp.fft calls
+(XLA lowers these well); the distributed slab path is
+sirius_tpu.parallel.dist_fft (shard_map + lax.all_to_all over the "g" mesh
+axis, sharded==replicated asserted in tests/test_dist_fft.py).
 """
 
 from __future__ import annotations

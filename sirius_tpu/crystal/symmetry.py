@@ -34,6 +34,12 @@ class SymmetryOp:
     perm: np.ndarray  # (natom,) atom a maps onto atom perm[a]
     w_k: np.ndarray  # (3,3) int reciprocal rotation (W^{-1})^T
     rot_cart: np.ndarray  # (3,3) cartesian rotation matrix
+    # collinear spin action (reference spin_rotation S(2,2)): magnetization
+    # is an axial vector, m'_z = det(R) R_zz m_z = spin_sign * m_z. +-1 for
+    # ops kept by the magnetic filter; +1 for nonmagnetic systems. AFM
+    # sublattice-swap ops carry -1 — symmetrizing m_z without it averages
+    # the staggered field to zero (NiO, verification/test05).
+    spin_sign: float = 1.0
 
 
 def _lattice_rotations(lattice: np.ndarray) -> np.ndarray:
@@ -119,20 +125,29 @@ def find_symmetry(
             if not ok or len(set(perm.tolist())) != natom:
                 continue
             rot_cart = lattice.T @ w @ inv_lat_t
+            detr = np.linalg.det(rot_cart)
+            spin_sign = float(np.sign(round(detr * rot_cart[2, 2]))) or 1.0
             if moments is not None and num_mag_dims > 0:
                 # moments are axial vectors: m' = det(R) R m; collinear case
                 # requires preservation up to the filter below
-                detr = np.linalg.det(rot_cart)
                 mrot = (moments @ rot_cart.T) * detr
                 if num_mag_dims == 1:
                     keep_op = np.allclose(mrot[:, 2], moments[perm][:, 2], atol=1e-4)
+                    # the collinear field transforms with det(R)*R_zz; for a
+                    # kept op on a magnetic system this must be exactly +-1
+                    if keep_op and np.any(np.abs(moments[:, 2]) > 1e-12):
+                        keep_op = abs(abs(detr * rot_cart[2, 2]) - 1.0) < 1e-6
+                        spin_sign = float(np.sign(detr * rot_cart[2, 2]))
                 else:
                     keep_op = np.allclose(mrot, moments[perm], atol=1e-4)
                 if not keep_op:
                     continue
             w_k = np.linalg.inv(w).T.round().astype(np.int64)
             ops.append(
-                SymmetryOp(w=w, t=t, perm=perm, w_k=w_k, rot_cart=rot_cart)
+                SymmetryOp(
+                    w=w, t=t, perm=perm, w_k=w_k, rot_cart=rot_cart,
+                    spin_sign=spin_sign,
+                )
             )
             seen_t.append(t)
     return ops

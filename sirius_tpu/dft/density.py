@@ -152,7 +152,9 @@ def initial_magnetization_g(ctx: SimulationContext) -> np.ndarray:
     return initial_magnetization_vec_g(ctx)[2]
 
 
-def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
+def symmetrize_pw(
+    ctx: SimulationContext, f_g: np.ndarray, axial_z: bool = False
+) -> np.ndarray:
     """Symmetrize PW coefficients over the space group.
 
     f'(r) = (1/N) sum_S f(S^{-1} r) with S: x -> W x + t gives, for
@@ -160,7 +162,12 @@ def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
         f'(g') += f(g) e^{-2 pi i g'. t} / N
     (reference symmetrize_pw_function.hpp via Gvec_shells remap). The sphere
     is rotation-invariant so every image lands inside the set; rotation
-    tables per op are cached on the context's gvec."""
+    tables per op are cached on the context's gvec.
+
+    axial_z: the field is the z-component of an axial vector (collinear
+    magnetization / B_xc): each op's contribution carries its spin_sign
+    (= det(R) R_zz, reference spin_rotation S(2,2)) — without it AFM
+    sublattice-swap ops average the staggered field to zero."""
     sym = ctx.symmetry
     gv = ctx.gvec
     cache = getattr(ctx, "_sym_rot_cache", None)
@@ -171,11 +178,11 @@ def symmetrize_pw(ctx: SimulationContext, f_g: np.ndarray) -> np.ndarray:
             gm = gv.millers @ op.w_k.T  # rows g' = w_k g
             idx = np.asarray([lut[tuple(m)] for m in gm], dtype=np.int64)
             phase = np.exp(-2j * np.pi * (gm @ op.t))
-            cache.append((idx, phase))
+            cache.append((idx, phase, op.spin_sign))
         ctx._sym_rot_cache = cache
     out = np.zeros_like(f_g)
-    for idx, phase in cache:
-        np.add.at(out, idx, f_g * phase)
+    for idx, phase, ssign in cache:
+        np.add.at(out, idx, f_g * (phase * ssign if axial_z else phase))
     return out / sym.num_ops
 
 
@@ -214,26 +221,31 @@ def symmetrize_density_matrix(ctx: SimulationContext, dm: np.ndarray) -> np.ndar
     dm'[S a] += D(S) dm[a] D(S)^T per atom block, with D block-diagonal over
     the radial functions (real-harmonic Wigner blocks per l).
 
-    dm: [ns, nbeta_tot, nbeta_tot] complex; collinear spins transform
-    independently (no spin rotation without spin-orbit). Only the per-atom
-    diagonal blocks are symmetrized and returned — inter-atom blocks come
-    back zero (no consumer reads them; the reference stores the dm per atom
-    and has no inter-atom blocks at all)."""
+    dm: [ns, nbeta_tot, nbeta_tot] complex. Collinear spin channels swap
+    under ops whose spin_sign is -1 (AFM sublattice swaps: the reference's
+    spin_rotation maps up<->dn there); with spin_sign +1 they transform
+    independently. Only the per-atom diagonal blocks are symmetrized and
+    returned — inter-atom blocks come back zero (no consumer reads them;
+    the reference stores the dm per atom and has no inter-atom blocks at
+    all)."""
     sym = ctx.symmetry
     if sym is None or sym.num_ops <= 1:
         return dm
     uc = ctx.unit_cell
+    ns = dm.shape[0]
     blocks = list(ctx.beta.atom_blocks(uc))
     off_by_atom = {ia: off for ia, off, _ in blocks}
     out = np.zeros_like(dm)
     for op in sym.ops:
         rot_by_type = _beta_rotation_blocks(ctx, op)
+        flip = ns == 2 and op.spin_sign < 0
         for ia, off, nbf in blocks:
             r = rot_by_type[uc.type_of_atom[ia]]
             joff = off_by_atom[int(op.perm[ia])]
-            for ispn in range(dm.shape[0]):
+            for ispn in range(ns):
+                src = (1 - ispn) if flip else ispn
                 out[ispn, joff : joff + nbf, joff : joff + nbf] += (
-                    r @ dm[ispn, off : off + nbf, off : off + nbf] @ r.T
+                    r @ dm[src, off : off + nbf, off : off + nbf] @ r.T
                 )
     return out / sym.num_ops
 

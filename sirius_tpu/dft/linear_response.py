@@ -1,0 +1,96 @@
+"""DFPT-style linear response on a converged ground state.
+
+Reference: the `sirius_linear_solver` C-API entry (src/api/sirius_api.cpp:6101)
+that Quantum ESPRESSO's phonon/DFPT code drives, backed by the block-CG
+solver (src/multi_cg/multi_cg.hpp) and the Sternheimer operator
+A_i = H - eps_i S + alpha_pv sum_occ S|psi><psi|S
+(lr::Linear_response_operator).
+
+This module is that call's consumer-facing equivalent: given the converged
+(psi, eps, occ) of one k-point/spin and a perturbation applied to the
+occupied states (dv_psi = dV . psi), it solves for the first-order orbital
+response dpsi and assembles the density response drho. The solve runs
+through solvers.multi_cg — fixed-shape masked CG, jit-able end to end.
+
+Conventions: psi rows are bands ([nb, ngk], S-normalized as produced by the
+band solver); the CG works on column blocks [ngk, nrhs] internally.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.solvers.multi_cg import multi_cg, sternheimer_operator
+
+
+def solve_sternheimer_k(
+    apply_h_s,
+    params,
+    psi_occ,  # [nocc, ngk] converged occupied states at this (k, spin)
+    eps_occ,  # [nocc] their band energies
+    dv_psi,  # [nocc, ngk] perturbation applied to each state, (dV psi_i)
+    alpha_pv: float = 1.0,
+    tol: float = 1e-10,
+    maxiter: int = 200,
+):
+    """First-order orbital response dpsi [nocc, ngk] of one (k, spin).
+
+    Solves (H - eps_i S + alpha_pv S P S) dpsi_i = -Pc dv_psi_i with
+    P = sum_occ |psi><psi| and Pc = 1 - S P the conduction projector: the
+    right-hand side is projected out of the occupied manifold exactly like
+    the reference (QE convention), and the alpha_pv shift makes the
+    operator nonsingular there. Returns (dpsi, niter, res_norms)."""
+    psi_c = jnp.asarray(psi_occ).T  # [ngk, nocc] columns
+    eps = jnp.asarray(eps_occ)
+
+    def apply_cols(x_cols):
+        hx, sx = apply_h_s(params, x_cols.T)
+        return hx.T, sx.T
+
+    apply_a = sternheimer_operator(apply_cols, psi_c, eps, alpha_pv)
+    _, s_psi = apply_cols(psi_c)
+
+    b = -jnp.asarray(dv_psi).T  # [ngk, nocc]
+    # conduction projection of the rhs: b <- b - S psi (psi^H b)
+    b = b - s_psi @ (jnp.conj(psi_c).T @ b)
+
+    x0 = jnp.zeros_like(b)
+    x, niter, res = multi_cg(apply_a, x0, b, tol=tol, maxiter=maxiter)
+    return x.T, niter, res
+
+
+def density_response_k(
+    ctx,
+    ik: int,
+    psi_occ: np.ndarray,  # [nocc, ngk]
+    dpsi: np.ndarray,  # [nocc, ngk]
+    occ: np.ndarray,  # [nocc] occupations (incl. k-weight if desired)
+) -> np.ndarray:
+    """drho(r) on the coarse box from the orbital response of one k:
+    drho = sum_i f_i (psi_i* dpsi_i + c.c.) / Omega."""
+    from sirius_tpu.core.fftgrid import g_to_r
+
+    dims = ctx.fft_coarse.dims
+    fft_index = jnp.asarray(ctx.gkvec.fft_index[ik])
+    psi_r = np.asarray(
+        g_to_r(jnp.asarray(psi_occ), fft_index, dims)
+    )
+    dpsi_r = np.asarray(g_to_r(jnp.asarray(dpsi), fft_index, dims))
+    acc = np.einsum(
+        "b,bxyz->xyz", np.asarray(occ), 2.0 * np.real(np.conj(psi_r) * dpsi_r)
+    )
+    return acc / ctx.unit_cell.omega
+
+
+def apply_local_perturbation(ctx, ik: int, dv_r: np.ndarray, psi: np.ndarray):
+    """dv_psi_i = dV(r) psi_i(r) gathered back onto the G+k sphere;
+    dv_r: real potential perturbation on the coarse box."""
+    from sirius_tpu.core.fftgrid import g_to_r, r_to_g
+
+    dims = ctx.fft_coarse.dims
+    fft_index = jnp.asarray(ctx.gkvec.fft_index[ik])
+    psi_r = g_to_r(jnp.asarray(psi), fft_index, dims)
+    prod = psi_r * jnp.asarray(dv_r)
+    out = r_to_g(prod, fft_index, dims)
+    return np.asarray(out) * np.asarray(ctx.gkvec.mask[ik])

@@ -127,7 +127,11 @@ class PawTypeData:
         xi_rf = idxrf
         xi_lm = np.asarray([lm_index(l, m) for l, m in zip(ls, ms)])
 
-        pts, w = _sphere_quadrature(4 * lmax_rho + 2)
+        # quadrature order matches the reference's SHT Lebedev mesh
+        # (sht.hpp: Lebedev_Laikov_npoint(2*lmax) with lmax = lmax_rho):
+        # the on-site XC is DEFINED on that grid, so deck parity requires
+        # the same resolution — a denser grid changes e_xc by ~2e-5 (Fe)
+        pts, w = _sphere_quadrature(2 * lmax_rho)
         # some generators start the mesh at r = 0; the on-site densities
         # divide by r^2 and the Poisson solve by r^(l+1), so guard the origin
         r_safe = r.copy()
@@ -344,43 +348,28 @@ def xc_onsite(t: PawTypeData, rho_lm: np.ndarray, core: np.ndarray, xc):
 def xc_onsite_gga(t: PawTypeData, rho_lm: np.ndarray, core: np.ndarray, xc):
     """GGA XC on the radial x angular grid.
 
-    Channel densities are projected like the LDA case; their cartesian
-    gradients use the exact spectral muffin-tin gradient (dft/mt_gradient,
-    reference spheric_function.hpp:559 via xc_mt.cpp) padded one l higher so
-    no gradient content is truncated. The potential's -div(...) term is
-    assembled spectrally as well and evaluated with the same quadrature."""
+    Reference scheme (xc_mt.cpp): channel densities and their spectral
+    cartesian gradients (dft/mt_gradient, reference
+    spheric_function.hpp:559) are truncated at the SHT lmax and evaluated
+    on the order-2*lmax mesh (t.rlm / t.ang_pts_w) — the on-site XC is
+    DEFINED on that grid, so deck parity requires matching its resolution.
+    The potential's -div(...) term is assembled spectrally and evaluated
+    with the same quadrature."""
     import jax.numpy as jnp
 
-    from sirius_tpu.core.sht import num_lm, ylm_real
     from sirius_tpu.dft.mt_gradient import divergence_lm_real, gradient_lm_real
 
     nmag1 = rho_lm.shape[0]
-    lmax = int(np.sqrt(rho_lm.shape[1])) - 1
-    lmax_g = lmax + 1
-    lmmax_g = num_lm(lmax_g)
-    # quadrature dense enough for products at lmax_g (cached on the type)
-    rlm_g = getattr(t, "_rlm_gga", None)
-    if rlm_g is None:
-        from sirius_tpu.core.sht import _sphere_quadrature
-
-        pts, w = _sphere_quadrature(3 * lmax_g + 2)
-        rlm_g = ylm_real(lmax_g, pts)
-        t._rlm_gga = rlm_g
-        t._gga_w = w
-    w_pts = t._gga_w
-
-    def pad(f):
-        out = np.zeros((lmmax_g,) + f.shape[1:])
-        out[: f.shape[0]] = f
-        return out
+    rlm_g = t.rlm
+    w_pts = t.ang_pts_w
 
     rho0 = rho_lm[0].copy()
     rho0[0] += core / Y00
     if nmag1 == 2:
-        up_lm = 0.5 * pad(rho0 + rho_lm[1])
-        dn_lm = 0.5 * pad(rho0 - rho_lm[1])
+        up_lm = 0.5 * (rho0 + rho_lm[1])
+        dn_lm = 0.5 * (rho0 - rho_lm[1])
     else:
-        up_lm = dn_lm = 0.5 * pad(rho0)
+        up_lm = dn_lm = 0.5 * rho0
     gu = gradient_lm_real(up_lm, t.r)  # [3, lmmax_g, nr]
     gd = gu if nmag1 == 1 else gradient_lm_real(dn_lm, t.r)
 

@@ -144,7 +144,7 @@ def generate_potential(
     if ctx.symmetry is not None and ctx.symmetry.num_ops > 1 and ctx.cfg.parameters.use_symmetry:
         veff_g = symmetrize_pw(ctx, veff_g)
         if bz_g is not None:
-            bz_g = symmetrize_pw(ctx, bz_g)
+            bz_g = symmetrize_pw(ctx, bz_g, axial_z=True)
 
     # per-spin potentials on the coarse box for the local operator
     def to_coarse(f_g):

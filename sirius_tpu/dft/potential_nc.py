@@ -57,7 +57,7 @@ def symmetrize_vector_pw(ctx: SimulationContext, mvec_g: np.ndarray) -> np.ndarr
         symmetrize_pw(ctx, np.zeros(gv.num_gvec, dtype=np.complex128))
         cache = ctx._sym_rot_cache
     out = np.zeros_like(mvec_g)
-    for op, (idx, phase) in zip(sym.ops, cache):
+    for op, (idx, phase, _ssign) in zip(sym.ops, cache):
         rot = np.linalg.det(op.rot_cart) * op.rot_cart  # axial vector
         m_rot = rot @ mvec_g  # [3, ng]
         buf = np.zeros_like(mvec_g)

@@ -536,7 +536,7 @@ def run_scf(
         if do_symmetrize:
             rho_new = symmetrize_pw(ctx, rho_new)
             if polarized:
-                mag_new = symmetrize_pw(ctx, mag_new)
+                mag_new = symmetrize_pw(ctx, mag_new, axial_z=True)
         paw_dm_new = (
             paw.dm_from_density_matrix(dm_by_spin) if paw is not None else None
         )

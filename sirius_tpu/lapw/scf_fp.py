@@ -627,13 +627,14 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             rho_mt_new = symmetrize_mt(rho_mt_new, ctx.sym.ops, ctx.lmax_rho)
             rho_r_new = ctx.g2r(rho_ig_new)
             if nm:
-                # collinear m_z transforms as a scalar over the magnetic
-                # group (the finder already filtered moment-breaking ops)
+                # collinear m_z is the z-component of an axial vector: each
+                # op carries spin_sign = det(R) R_zz (sublattice-swap ops
+                # are -1; without the sign AFM fields average to zero)
                 mag_ig_new = symmetrize_pw_fp(
-                    mag_ig_new, ctx.sym.ops, ctx.gvec.millers
+                    mag_ig_new, ctx.sym.ops, ctx.gvec.millers, axial_z=True
                 )
                 mag_mt_new = symmetrize_mt(
-                    mag_mt_new, ctx.sym.ops, ctx.lmax_rho
+                    mag_mt_new, ctx.sym.ops, ctx.lmax_rho, axial_z=True
                 )
                 mag_r_new = ctx.g2r(mag_ig_new)
 

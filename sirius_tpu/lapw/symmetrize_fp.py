@@ -39,12 +39,17 @@ def rlm_rotation_matrix(lmax: int, rot_cart: np.ndarray) -> np.ndarray:
     return D
 
 
-def symmetrize_mt(f_mt_by_atom, ops, lmax: int):
-    """(1/N) sum_S D(W) f_{S^{-1}(a)} per atom; ops carry perm/rot_cart."""
+def symmetrize_mt(f_mt_by_atom, ops, lmax: int, axial_z: bool = False):
+    """(1/N) sum_S D(W) f_{S^{-1}(a)} per atom; ops carry perm/rot_cart.
+
+    axial_z: the field is collinear magnetization — each op's contribution
+    carries its spin_sign (det(R) R_zz), as in the PW symmetrizer."""
     nat = len(f_mt_by_atom)
     out = [np.zeros_like(f) for f in f_mt_by_atom]
     for op in ops:
         D = rlm_rotation_matrix(lmax, op.rot_cart)
+        if axial_z:
+            D = D * op.spin_sign
         invperm = np.argsort(op.perm)  # ja = invperm[ia]: op maps ja -> ia
         for ia in range(nat):
             out[ia] += np.einsum(
@@ -53,8 +58,14 @@ def symmetrize_mt(f_mt_by_atom, ops, lmax: int):
     return [f / len(ops) for f in out]
 
 
-def symmetrize_pw_fp(f_g: np.ndarray, ops, millers: np.ndarray) -> np.ndarray:
+def symmetrize_pw_fp(
+    f_g: np.ndarray, ops, millers: np.ndarray, axial_z: bool = False
+) -> np.ndarray:
     """f'(g') += f(g) e^{-2 pi i g'.t} / N over g' = (W^{-1})^T g.
+
+    axial_z: multiply each op's contribution by its spin_sign (collinear
+    magnetization is the z-component of an axial vector; without the sign
+    AFM sublattice-swap ops average the staggered field to zero).
 
     Vectorized miller lookup via linear keys + searchsorted (the fine FP
     G set is ~1e5 vectors; a dict LUT would dominate)."""
@@ -76,5 +87,7 @@ def symmetrize_pw_fp(f_g: np.ndarray, ops, millers: np.ndarray) -> np.ndarray:
         idx = order[pos]
         ok = k0s[pos] == km
         phase = np.exp(-2j * np.pi * (gm @ op.t))
+        if axial_z:
+            phase = phase * op.spin_sign
         np.add.at(out, idx[ok], (f_g * phase)[ok])
     return out / len(ops)

@@ -1,0 +1,142 @@
+"""Distributed 3-D FFT over a "g" mesh axis (slab decomposition).
+
+Reference mechanism: SpFFT slab FFTs over z-columns of the box with MPI
+transposes (src/core/fft/gvec.hpp:805 Gvec_fft, fft.hpp:29-95), used when
+a replicated FFT box per band stops fitting (Si-511 class: ~1e6 G x ~2e3
+bands). TPU-native equivalent: shard the box's FIRST axis over the "g"
+mesh axis, do local FFTs over the two unsharded axes, one
+lax.all_to_all re-slab, then the FFT along the remaining axis —
+exactly the slab algorithm, with the MPI alltoall replaced by the ICI
+collective.
+
+Layouts (P = mesh size along "g"):
+  x-slabs:  [n1/P, n2, n3]  per shard (sharded axis 0)
+  y-slabs:  [n1, n2/P, n3]  per shard (sharded axis 1)
+
+fft3d(box sharded x-slabs) -> full FFT, sharded y-slabs; ifft3d inverts.
+n1 and n2 must be divisible by P (good_fft_size can always pad to a
+multiple — the driver chooses box dims with the mesh in mind).
+
+All entry points are shard_map'ed pure functions: call them inside jit
+with arrays already device-put to the matching NamedSharding (see
+tests/test_dist_fft.py for the canonical wiring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def x_slab_spec() -> P:
+    """Spec of a [..., n1, n2, n3] box sharded into x-slabs over "g"."""
+    return P(None, "g", None, None)
+
+
+def y_slab_spec() -> P:
+    return P(None, None, "g", None)
+
+
+def _fft_local_yz(slab):
+    return jnp.fft.fftn(slab, axes=(-2, -1))
+
+
+def _reslab_x_to_y(slab, axis_name: str):
+    """[n1/P, n2, n3] x-slab -> [n1, n2/P, n3] y-slab via one all_to_all.
+
+    Split the y axis into P blocks, exchange so every shard receives its
+    y-block from all x-slabs, and concatenate along x."""
+    # slab: [..., n1p, n2, n3] -> split axis -2 into P chunks, all_to_all
+    # over the chunk axis, then merge the received x-chunks along axis -3
+    return jax.lax.all_to_all(
+        slab, axis_name, split_axis=slab.ndim - 2, concat_axis=slab.ndim - 3,
+        tiled=True,
+    )
+
+
+def _reslab_y_to_x(slab, axis_name: str):
+    return jax.lax.all_to_all(
+        slab, axis_name, split_axis=slab.ndim - 3, concat_axis=slab.ndim - 2,
+        tiled=True,
+    )
+
+
+def fft3d_shard(slab, axis_name: str = "g"):
+    """Forward 3-D FFT of an x-slab-sharded box; result is y-slab sharded.
+
+    slab: [..., n1/P, n2, n3] local block (call inside shard_map)."""
+    slab = _fft_local_yz(slab)
+    slab = _reslab_x_to_y(slab, axis_name)  # [..., n1, n2/P, n3]
+    return jnp.fft.fft(slab, axis=-3)
+
+
+def ifft3d_shard(slab, axis_name: str = "g"):
+    """Inverse of fft3d_shard: y-slab-sharded spectrum -> x-slab box."""
+    slab = jnp.fft.ifft(slab, axis=-3)
+    slab = _reslab_y_to_x(slab, axis_name)  # [..., n1/P, n2, n3]
+    return jnp.fft.ifftn(slab, axes=(-2, -1))
+
+
+def make_dist_fft(mesh: Mesh, dims: tuple[int, int, int], batch: int):
+    """jitted (fft, ifft) pair over `mesh`'s "g" axis for boxes
+    [batch, n1, n2, n3]; inputs/outputs carry the slab NamedShardings."""
+    npg = mesh.shape["g"]
+    n1, n2, _ = dims
+    if n1 % npg or n2 % npg:
+        raise ValueError(
+            f"box dims {dims} not divisible by mesh axis g={npg}; pick "
+            "good_fft_size multiples of the mesh size"
+        )
+    xs = NamedSharding(mesh, x_slab_spec())
+    ys = NamedSharding(mesh, y_slab_spec())
+
+    fwd = jax.jit(
+        jax.shard_map(
+            partial(fft3d_shard, axis_name="g"),
+            mesh=mesh, in_specs=x_slab_spec(), out_specs=y_slab_spec(),
+        ),
+        in_shardings=xs, out_shardings=ys,
+    )
+    inv = jax.jit(
+        jax.shard_map(
+            partial(ifft3d_shard, axis_name="g"),
+            mesh=mesh, in_specs=y_slab_spec(), out_specs=x_slab_spec(),
+        ),
+        in_shardings=ys, out_shardings=xs,
+    )
+    return fwd, inv
+
+
+def make_apply_veff_dist(mesh: Mesh, dims: tuple[int, int, int]):
+    """Distributed local-operator core V.psi: spectral boxes in, spectral
+    boxes out, every stage slab-sharded over "g" (the reference's per-band
+    SpFFT loop body, local_operator.cpp:320-370, as two distributed
+    transforms around a sharded pointwise multiply).
+
+    Returns a jitted fn(psi_spec [nb, n1, n2, n3] y-slab-sharded spectrum,
+    veff_r [n1, n2, n3] x-slab-sharded real potential) -> y-slab spectrum
+    of V.psi. With the module's conventions (f(r) = N ifftn(F)) the N
+    factors cancel: F' = fft3d(ifft3d(F) * V)."""
+    npg = mesh.shape["g"]
+    n1, n2, _ = dims
+    if n1 % npg or n2 % npg:
+        raise ValueError(f"box dims {dims} not divisible by g={npg}")
+    ys = NamedSharding(mesh, y_slab_spec())
+    vxs = NamedSharding(mesh, P("g", None, None))
+
+    def _core(psi_spec, veff):
+        r = ifft3d_shard(psi_spec, "g")  # [nb, n1/P, n2, n3] x-slab real
+        r = r * veff[None]
+        return fft3d_shard(r, "g")
+
+    return jax.jit(
+        jax.shard_map(
+            _core, mesh=mesh,
+            in_specs=(y_slab_spec(), P("g", None, None)),
+            out_specs=y_slab_spec(),
+        ),
+        in_shardings=(ys, vxs), out_shardings=ys,
+    )

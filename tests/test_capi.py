@@ -29,6 +29,24 @@ def test_c_api_end_to_end():
     assert "C API OK" in out.stdout
 
 
+@pytest.mark.skipif(not RUN, reason="set SIRIUS_TPU_DECKS=1 to run")
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_c_api_per_step_host_mixing():
+    """C host drives the SCF loop itself with host-side linear mixing
+    (QE embedding contract): separate find_eigen_states / generate_density
+    / generate_effective_potential calls + set/get_pw_coeffs must converge
+    to the single-shot energy."""
+    subprocess.run(["make", "test_api_steps"], cwd=CSRC, check=True,
+                   capture_output=True)
+    out = subprocess.run(
+        ["./test_api_steps", "/root/reference/verification/test23",
+         "-0.4507101", "1e-5"],
+        cwd=CSRC, capture_output=True, text=True, timeout=1800,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "C API STEPS OK" in out.stdout
+
+
 def test_capi_python_bridge_roundtrip():
     """The Python half alone: context assembly calls mutate the config the
     way load_config expects (no SCF — fast)."""

@@ -1,0 +1,58 @@
+"""Distributed slab FFT over the "g" mesh axis: sharded == replicated
+(VERDICT r2 item 10; reference Gvec_fft/SpFFT slab path,
+src/core/fft/gvec.hpp:805). Runs on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+
+from sirius_tpu.parallel.dist_fft import (
+    make_apply_veff_dist,
+    make_dist_fft,
+    x_slab_spec,
+    y_slab_spec,
+)
+
+
+def _g_mesh():
+    devs = np.array(jax.devices())
+    if len(devs) < 4:
+        pytest.skip("needs a multi-device mesh")
+    return Mesh(devs[:4].reshape(4), ("g",))
+
+
+def test_dist_fft_roundtrip_matches_replicated():
+    mesh = _g_mesh()
+    dims = (8, 12, 10)
+    nb = 3
+    rng = np.random.default_rng(7)
+    box = rng.standard_normal((nb, *dims)) + 1j * rng.standard_normal((nb, *dims))
+
+    fwd, inv = make_dist_fft(mesh, dims, nb)
+    xb = jax.device_put(jnp.asarray(box), NamedSharding(mesh, x_slab_spec()))
+    spec = fwd(xb)
+    np.testing.assert_allclose(
+        np.asarray(spec), np.fft.fftn(box, axes=(1, 2, 3)), atol=1e-10
+    )
+    back = inv(spec)
+    np.testing.assert_allclose(np.asarray(back), box, atol=1e-12)
+
+
+def test_dist_apply_veff_matches_replicated():
+    mesh = _g_mesh()
+    dims = (8, 8, 6)
+    nb = 4
+    rng = np.random.default_rng(3)
+    spec = rng.standard_normal((nb, *dims)) + 1j * rng.standard_normal((nb, *dims))
+    veff = rng.standard_normal(dims)
+
+    apply_v = make_apply_veff_dist(mesh, dims)
+    ys = NamedSharding(mesh, y_slab_spec())
+    out = apply_v(
+        jax.device_put(jnp.asarray(spec), ys),
+        jax.device_put(jnp.asarray(veff), NamedSharding(mesh, jax.sharding.PartitionSpec("g", None, None))),
+    )
+    expect = np.fft.fftn(np.fft.ifftn(spec, axes=(1, 2, 3)) * veff[None], axes=(1, 2, 3))
+    np.testing.assert_allclose(np.asarray(out), expect, atol=1e-10)

@@ -1,0 +1,38 @@
+"""Host-driven per-step SCF (QE embedding contract): the stepper's separate
+find_eigen_states / generate_density / generate_effective_potential calls
+with HOST-side mixing must converge to the single-shot run_scf energy
+(reference SURVEY §3.5 flow; src/api/sirius_api.cpp per-step entries)."""
+
+import numpy as np
+
+from sirius_tpu.config.schema import load_config
+
+BASE = "/root/reference/verification/test23"
+
+
+def test_stepper_host_mixing_matches_single_shot():
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.stepper import GroundStateStepper
+
+    cfg = load_config(BASE + "/sirius.json")
+    ref = run_scf(cfg, base_dir=BASE)["energy"]["total"]
+
+    cfg2 = load_config(BASE + "/sirius.json")
+    st = GroundStateStepper(cfg2, BASE)
+    beta = 0.7
+    e = None
+    for it in range(25):
+        st.find_eigen_states()
+        st.find_band_occupancies()
+        st.generate_density()
+        rho_in = st.get_pw_coeffs("rho")
+        rho_out = st.get_pw_coeffs("rho_out")
+        # HOST-side mixing (the embedding host owns the mixer)
+        st.set_pw_coeffs("rho", rho_in + beta * (rho_out - rho_in))
+        st.generate_effective_potential()
+        e_new = st.total_energy()["total"]
+        if e is not None and abs(e_new - e) < 1e-9:
+            e = e_new
+            break
+        e = e_new
+    assert abs(e - ref) < 1e-6, (e, ref)

@@ -30,7 +30,11 @@ VER = "/root/reference/verification"
 WIRED = [
     "test01",  # SrVO3 US LDA 2x2x2
     "test02",  # He FP-LAPW molecule LDA-VWN
+    "test03",  # Fe bcc PAW PBE collinear 4x4x4
     "test04",  # LiF PAW LDA 4x4x4
+    "test05",  # NiO US LDA collinear AFM 2x2x2
+    "test06",  # Fe 2-atom US LDA collinear 2x2x2
+    "test07",  # Ni US PBE collinear 4x4x4
     "test08",  # Si US LDA Gamma
     "test09",  # Ni non-collinear PBE 4x4x4
     "test15",  # LiF PAW LDA Gamma
