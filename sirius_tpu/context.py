@@ -26,7 +26,7 @@ from sirius_tpu.dft.radial_tables import (
     rho_core_form_factor,
     rho_total_form_factor,
     structure_factors,
-    vloc_form_factor,
+    vloc_ff,
 )
 from sirius_tpu.ops.augmentation import Augmentation
 from sirius_tpu.ops.beta import BetaProjectors
@@ -77,8 +77,18 @@ class SimulationContext:
             kpts = np.asarray(p.vk, dtype=np.float64)
             kw = np.full(len(kpts), 1.0 / len(kpts))
 
-        gvec = Gvec.build(uc.lattice, p.pw_cutoff)
-        fft_coarse = FFTGrid.for_cutoff(uc.lattice, 2 * p.gk_cutoff)
+        # fine/coarse FFT boxes: the reference's exact sizing (5-smooth,
+        # min grid around the sphere) — the nonlinear XC is evaluated on
+        # the fine box, so dims are part of the numerical definition;
+        # settings.fft_grid_size (recorded in every reference output)
+        # overrides when set
+        fgs = cfg.settings.fft_grid_size
+        if fgs and all(int(x) > 0 for x in fgs):
+            fft_fine = FFTGrid(tuple(int(x) for x in fgs))
+        else:
+            fft_fine = FFTGrid.ref_min_grid(uc.lattice, p.pw_cutoff)
+        gvec = Gvec.build(uc.lattice, p.pw_cutoff, fft=fft_fine)
+        fft_coarse = FFTGrid.ref_min_grid(uc.lattice, 2 * p.gk_cutoff)
         gvec_coarse = Gvec.build(uc.lattice, 2 * p.gk_cutoff, fft=fft_coarse)
         c2f = gvec.index_of_millers(gvec_coarse.millers)
         assert np.all(c2f >= 0)
@@ -96,7 +106,9 @@ class SimulationContext:
                     qmat[off : off + nbf, off : off + nbf] = at.q_mtrx
             beta = dataclasses.replace(beta, qmat=qmat)
         sfact = structure_factors(uc, gvec)
-        vloc_g = make_periodic_function(uc, gvec, vloc_form_factor, sfact)
+        vloc_g = make_periodic_function(
+            uc, gvec, vloc_ff(cfg.settings.pseudo_grid_cutoff), sfact
+        )
         rho_core_g = make_periodic_function(uc, gvec, rho_core_form_factor, sfact)
         rho_at_g = make_periodic_function(uc, gvec, rho_total_form_factor, sfact)
 

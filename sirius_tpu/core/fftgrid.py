@@ -60,6 +60,39 @@ class FFTGrid:
         dims = tuple(good_fft_size(int(2 * mi + 2)) for mi in m)
         return FFTGrid(dims)
 
+    @staticmethod
+    def ref_min_grid(lattice: np.ndarray, gmax: float) -> "FFTGrid":
+        """The reference's box sizing, exactly (fft3d_grid.hpp get_min_grid
+        + r3::find_translations + find_grid_size 5-smooth rounding). The
+        nonlinear XC is evaluated on this real-space box, so its SIZE is
+        part of the reference's numerical definition — energy parity at
+        the 1e-5 level requires the same dims, not merely sufficient ones.
+        """
+        a = np.asarray(lattice, dtype=np.float64)
+        # reference: find_translations(cutoff, RECIPROCAL lattice) — the
+        # count of b-lattice translations inside the diameter
+        b = 2.0 * np.pi * np.linalg.inv(a)  # columns of b are b_i? rows:
+        b = b.T  # rows are b_i
+        det = abs(np.linalg.det(b))
+        cr = [
+            np.cross(b[1], b[2]),
+            np.cross(b[0], b[2]),
+            np.cross(b[0], b[1]),
+        ]
+        lim = [int(2.0 * gmax * np.linalg.norm(c) / det) + 1 for c in cr]
+
+        def smooth5(n: int) -> int:
+            while True:
+                m = n
+                for k in (2, 3, 5):
+                    while m % k == 0:
+                        m //= k
+                if m == 1:
+                    return n
+                n += 1
+
+        return FFTGrid(tuple(smooth5(l + 2) for l in lim))
+
     @property
     def num_points(self) -> int:
         n1, n2, n3 = self.dims

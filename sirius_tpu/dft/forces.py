@@ -21,7 +21,7 @@ from scipy.special import erfc
 
 from sirius_tpu.context import SimulationContext
 from sirius_tpu.dft.ewald import ewald_lambda
-from sirius_tpu.dft.radial_tables import rho_core_form_factor, vloc_form_factor
+from sirius_tpu.dft.radial_tables import rho_core_form_factor, vloc_ff
 
 
 def _form_factor_force(
@@ -45,7 +45,7 @@ def _form_factor_force(
 
 def forces_vloc(ctx: SimulationContext, rho_g: np.ndarray) -> np.ndarray:
     """Local-potential force (reference force.cpp calc_forces_vloc)."""
-    return _form_factor_force(ctx, rho_g, vloc_form_factor)
+    return _form_factor_force(ctx, rho_g, vloc_ff(ctx.cfg.settings.pseudo_grid_cutoff))
 
 
 def forces_core(ctx: SimulationContext, vxc_g: np.ndarray) -> np.ndarray:
@@ -59,7 +59,9 @@ def forces_scf_corr(ctx: SimulationContext, rho_resid_g: np.ndarray) -> np.ndarr
     """First-order correction for incomplete SCF: the local-potential force
     of the density residual rho_out - rho_in (reference calc_forces_scf_corr);
     vanishes at convergence."""
-    return _form_factor_force(ctx, rho_resid_g, vloc_form_factor)
+    return _form_factor_force(
+        ctx, rho_resid_g, vloc_ff(ctx.cfg.settings.pseudo_grid_cutoff)
+    )
 
 
 def forces_ewald(ctx: SimulationContext) -> np.ndarray:

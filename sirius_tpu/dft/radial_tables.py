@@ -26,20 +26,35 @@ from sirius_tpu.core.gvec import Gvec
 from sirius_tpu.core.radial import Spline, spline_quadrature_weights
 from sirius_tpu.crystal.unit_cell import UnitCell
 
-PSEUDO_GRID_CUTOFF = 10.0  # a.u., reference settings.pseudo_grid_cutoff
+# default of reference settings.pseudo_grid_cutoff (the "QE tail hack");
+# NOTE some reference verification outputs were generated with 8.0 — the
+# deck harness replays the value recorded in output_ref.json's resolved
+# config (tools/run_decks.py), the 1e-5-class energy sensitivity is real
+PSEUDO_GRID_CUTOFF = 10.0
 
 
 def _truncate(r: np.ndarray, rc: float) -> int:
-    """Number of points with r <= rc (at least 2)."""
-    n = int(np.searchsorted(r, rc, side="right"))
+    """Reference-equivalent point count: radial_grid().index_of(rc) is the
+    last index with r <= rc and segment(np) keeps indices [0, np), so the
+    kept range STOPS one point short of that index. The truncated vloc
+    integrand does not decay (the QE tail hack exists precisely because of
+    that), so a one-point difference is a ~3e-5 Ha energy shift (SrVO3)."""
+    n = int(np.searchsorted(r, rc, side="right")) - 1
     return max(n, 2)
 
 
-def vloc_form_factor(atype, q: np.ndarray) -> np.ndarray:
-    """Local-potential form factor at |G| values q (may include 0)."""
+def vloc_ff(rc: float):
+    """Form-factor closure with a bound pseudo_grid_cutoff — the shared
+    wrapper for every consumer that threads the config value through."""
+    return lambda t, q: vloc_form_factor(t, q, rc=rc)
+
+
+def vloc_form_factor(atype, q: np.ndarray, rc: float | None = None) -> np.ndarray:
+    """Local-potential form factor at |G| values q (may include 0).
+    rc: integration cutoff (settings.pseudo_grid_cutoff)."""
     from scipy.special import erf
 
-    np_cut = _truncate(atype.r, PSEUDO_GRID_CUTOFF)
+    np_cut = _truncate(atype.r, PSEUDO_GRID_CUTOFF if rc is None else rc)
     r = atype.r[:np_cut]
     v = atype.vloc[:np_cut]
     w = spline_quadrature_weights(r)

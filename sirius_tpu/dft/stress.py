@@ -37,7 +37,7 @@ from sirius_tpu.dft.ewald import ewald_energy
 from sirius_tpu.dft.radial_tables import (
     rho_core_form_factor,
     structure_factors,
-    vloc_form_factor,
+    vloc_ff,
 )
 
 _H = 1e-5
@@ -66,7 +66,12 @@ class StressCalculator:
         self.sfact = structure_factors(uc, ctx.gvec)
         qmax_fine = ctx.cfg.parameters.pw_cutoff * 1.05
         qmax_gk = ctx.cfg.parameters.gk_cutoff * 1.05
-        self.vloc_tab = [_ff_table(vloc_form_factor, t, qmax_fine) for t in uc.atom_types]
+        self.vloc_tab = [
+            _ff_table(
+                vloc_ff(ctx.cfg.settings.pseudo_grid_cutoff), t, qmax_fine
+            )
+            for t in uc.atom_types
+        ]
         self.core_tab = [
             _ff_table(rho_core_form_factor, t, qmax_fine) if t.rho_core is not None else None
             for t in uc.atom_types

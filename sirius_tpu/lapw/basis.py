@@ -61,6 +61,7 @@ class AtomRadialBasis:
     aw: list
     lo: list
     enu: list
+    lo_enu: list = dataclasses.field(default_factory=list)  # resolved, per lo
 
     def overlap(self, f1: MtRadial, f2: MtRadial) -> float:
         return float(rint(f1.f * f2.f * self.r**2, self.r))
@@ -111,6 +112,7 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
         ])
         enu_l.append(e0)
     lo = []
+    lo_enu = []
     from sirius_tpu.lapw.radial_solver import radial_dme_chain
 
     for d in sp.lo:
@@ -119,16 +121,19 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
         # energy share one derivative chain
         chains: dict = {}
         comps = []  # (u, hu, uR, upR) per basis entry
+        e_res = []
         for be in d.basis:
             e0 = be.enu
             if be.auto:
                 n = be.n if be.n > 0 else l + 1
                 e0 = find_enu(r, v_sph, l, n, rel)
+            e_res.append(e0)
             key = round(e0, 12)
             need = be.dme
             if key not in chains or len(chains[key]) <= need:
                 chains[key] = radial_dme_chain(r, v_sph, l, e0, rel, max_m=need)
             comps.append(chains[key][be.dme])
+        lo_enu.append(min(e_res))
         if len(comps) != 2:
             raise NotImplementedError(
                 f"lo with {len(comps)} radial components (2 supported)"
@@ -150,7 +155,9 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
                 fpR=(ca * uapR + cb * ubpR) / nrm,
             )
         )
-    return AtomRadialBasis(lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l)
+    return AtomRadialBasis(
+        lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l, lo_enu=lo_enu
+    )
 
 
 def sph_bessel(lmax: int, x: np.ndarray) -> np.ndarray:

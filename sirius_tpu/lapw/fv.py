@@ -181,29 +181,54 @@ def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
     return H, O
 
 
-def diagonalize_fv(H, O, nev: int):
+def _filtered_solve(H, O, nev, s, u, good):
+    t = u[:, good] * (1.0 / np.sqrt(s[good]))[None, :]
+    a = t.conj().T @ H @ t
+    a = 0.5 * (a + a.conj().T)
+    e, c = np.linalg.eigh(a)
+    v = t @ c[:, :nev]
+    return e[:nev], v
+
+
+def diagonalize_fv(H, O, nev: int, e_floor: float | None = None):
     """Lowest nev of the generalized problem. LAPACK's subset driver
     (Cholesky + syevr) is ~6x faster than a full eigh at LAPW sizes when
-    nev << n; fall back to an explicitly regularized transform when the
-    overlap is numerically singular (near-dependent lo + APW sets)."""
+    nev << n.
+
+    e_floor: ghost guard. A near-null O direction amplifies the MT
+    quadrature noise of H by |c|^2 / (x^H O x) and can surface as a
+    spurious DEEP state (classic lo+APW linear-dependence ghost; Fe test19
+    had one at -16.5 Ha from an O eigenvalue at 1.6e-4 relative — the
+    reference's davidson path removes such components via
+    get_singular_components, diagonalize_fp.hpp:238). When the computed
+    spectrum dips below e_floor, the smallest O components are dropped one
+    at a time until it recovers; a FIXED relative threshold is wrong (He
+    molecule boxes legitimately carry small O components)."""
     nev = min(nev, H.shape[0])
     try:
-        # guard the fast path against QUIET ill-conditioning (near-dependent
-        # lo+APW sets pass Cholesky but poison the spectrum with ghosts):
-        # diag(L) spans ~sqrt of O's spectrum — cheap rank proxy
+        from scipy.linalg import eigh as seigh
+
         L = np.linalg.cholesky(O)
         d = np.real(np.diag(L))
         if d.min() < 1e-7 * d.max():
             raise np.linalg.LinAlgError("overlap nearly singular")
-        from scipy.linalg import eigh as seigh
-
         e, v = seigh(H, O, subset_by_index=[0, nev - 1])
-        return e, v
+        if e_floor is None or e[0] > e_floor:
+            return e, v
     except (ImportError, ValueError, np.linalg.LinAlgError):
-        s, u = np.linalg.eigh(O)
-        good = s > 1e-9 * s.max()
-        t = u[:, good] * (1.0 / np.sqrt(s[good]))[None, :]
-        a = t.conj().T @ H @ t
-        e, c = np.linalg.eigh(a)
-        v = t @ c[:, :nev]
-        return e[:nev], v
+        pass
+    s, u = np.linalg.eigh(O)
+    order = np.argsort(s)
+    good = s > 1e-9 * s.max()
+    e, v = _filtered_solve(H, O, nev, s, u, good)
+    if e_floor is not None:
+        for i in range(12):
+            if not np.any(good) or len(e) == 0 or e[0] > e_floor:
+                break
+            # drop the smallest surviving O component
+            for idx in order:
+                if good[idx]:
+                    good[idx] = False
+                    break
+            e, v = _filtered_solve(H, O, nev, s, u, good)
+    return e, v

@@ -53,7 +53,7 @@ from sirius_tpu.lapw.poisson_fp import (
     interstitial_potential_g,
 )
 from sirius_tpu.lapw.species import FpSpecies, step_function_g
-from sirius_tpu.lapw.xc_fp import MtSht, interstitial_xc, mt_xc
+from sirius_tpu.lapw.xc_fp import MtSht, gcart_box, interstitial_xc, mt_xc
 
 Y00 = 1.0 / np.sqrt(4.0 * np.pi)
 
@@ -297,9 +297,14 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
             e, u = find_bound_state(r, v, l, nql, rel=rel, e_lo=e_floor)
             esum += occ * e
             rho += occ * u**2 / (4.0 * np.pi)
-    nmt = len(r_mt)
-    leak = 4.0 * np.pi * np.trapezoid(rho[nmt:] * r[nmt:] ** 2, r[nmt:])
-    return rho[:nmt], esum, leak
+    # rho lives on the midpoint-REFINED grid: sample back on the original
+    # MT points (even indices) — slicing by the coarse point count would
+    # return fine-grid values at wrong radii (Fe: a 354179-electron "core")
+    rho_mt_out = rho[0:nmt_fine:2]
+    leak = 4.0 * np.pi * np.trapezoid(
+        rho[nmt_fine - 1 :] * r[nmt_fine - 1 :] ** 2, r[nmt_fine - 1 :]
+    )
+    return rho_mt_out, esum, leak
 
 
 def run_scf_fp(cfg, base_dir: str = ".") -> dict:
@@ -420,11 +425,16 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         # XC
         rho_r = ctx.g2r(rho_ig)
         bxc_r, bxc_mt = None, [None] * nat
+        gbox = None
+        if ctx.xc.is_gga:
+            gbox = getattr(ctx, "_gbox", None)
+            if gbox is None:
+                gbox = ctx._gbox = gcart_box(ctx.dims, ctx.lattice)
         if nm:
             mag_r = ctx.g2r(mag_ig)
-            vxc_r, exc_r, bxc_r = interstitial_xc(rho_r, ctx.xc, mag_r)
+            vxc_r, exc_r, bxc_r = interstitial_xc(rho_r, ctx.xc, mag_r, gbox=gbox)
         else:
-            vxc_r, exc_r = interstitial_xc(rho_r, ctx.xc)
+            vxc_r, exc_r = interstitial_xc(rho_r, ctx.xc, gbox=gbox)
         vxc_mt, exc_mt = [], []
         for ia in range(nat):
             v, ex, bx = mt_xc(
@@ -453,6 +463,12 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             core_rho.append(cr)
             core_esum += ce
             core_leak += cl
+        # ghost guard for the fv solve: nothing physical lies far below the
+        # deepest RESOLVED linearization energy of the valence basis
+        enu_all = [e for b in basis_by_atom for e in b.enu] + [
+            e for b in basis_by_atom for e in b.lo_enu
+        ]
+        e_floor_fv = min(enu_all) - 5.0
         core_esum_tot = core_esum
 
         # ---- band problem per k: first variation (no B field) ----
@@ -466,7 +482,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 [v[:lmmax_pot] for v in veff_mt],
                 th_box, vth_box, ctx.dims, ctx.omega,
             )
-            ev, C = diagonalize_fv(H, O, nev)
+            ev, C = diagonalize_fv(H, O, nev, e_floor=e_floor_fv)
             evals_k.append(ev)
             C_k.append(C)
 

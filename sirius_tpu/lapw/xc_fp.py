@@ -44,20 +44,78 @@ class MtSht:
         """[np, nr] -> [lmmax_pot, nr] real-harmonic projection."""
         return (self.rlm_pot * self.w[:, None]).T @ f_pt
 
+    def to_lm_rho(self, f_pt: np.ndarray) -> np.ndarray:
+        """[np, nr] -> [lmmax_rho, nr] projection (GGA gradient fields)."""
+        return (self.rlm_rho * self.w[:, None]).T @ f_pt
+
+
+def mt_xc_gga(rho_lm, r, xc, sht: MtSht, mag_lm=None):
+    """GGA muffin-tin XC (reference xc_mt.cpp GGA branch): spectral
+    cartesian gradients (dft/mt_gradient, spheric_function.hpp:559) of the
+    channel densities, sigma on the angular grid, and the -div(vsigma
+    grad n) potential term assembled spectrally and re-evaluated on the
+    same quadrature — the identical scheme validated on the PAW on-site
+    densities (dft/paw.xc_onsite_gga)."""
+    import jax.numpy as jnp
+
+    from sirius_tpu.dft.mt_gradient import divergence_lm_real, gradient_lm_real
+
+    nlm = rho_lm.shape[0]
+    if mag_lm is None:
+        up_lm = dn_lm = 0.5 * rho_lm
+    else:
+        m = mag_lm if mag_lm.shape[0] == nlm else np.pad(
+            mag_lm, ((0, nlm - mag_lm.shape[0]), (0, 0))
+        )
+        up_lm = 0.5 * (rho_lm + m)
+        dn_lm = 0.5 * (rho_lm - m)
+    gu = gradient_lm_real(up_lm, r)
+    gd = gu if mag_lm is None else gradient_lm_real(dn_lm, r)
+    to_pt = sht.to_grid
+    up = np.maximum(to_pt(up_lm), 1e-20)
+    dn = np.maximum(to_pt(dn_lm), 1e-20)
+    gu_pt = np.stack([to_pt(gu[i]) for i in range(3)])
+    gd_pt = gu_pt if mag_lm is None else np.stack([to_pt(gd[i]) for i in range(3)])
+    suu = np.sum(gu_pt**2, axis=0)
+    sud = np.sum(gu_pt * gd_pt, axis=0)
+    sdd = np.sum(gd_pt**2, axis=0)
+    shape = up.shape
+    out = xc.evaluate_polarized(
+        jnp.asarray(up.ravel()), jnp.asarray(dn.ravel()),
+        jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()),
+        jnp.asarray(sdd.ravel()),
+    )
+    e = np.asarray(out["e"]).reshape(shape)
+    vu = np.asarray(out["v_up"]).reshape(shape)
+    vd = np.asarray(out["v_dn"]).reshape(shape)
+    vsuu = np.asarray(out["vsigma_uu"]).reshape(shape)
+    vsud = np.asarray(out["vsigma_ud"]).reshape(shape)
+    vsdd = np.asarray(out["vsigma_dd"]).reshape(shape)
+    # W_s = 2 vsigma_ss grad n_s + vsigma_ud grad n_other; v_s -= div W_s
+    proj = lambda f: sht.to_lm_rho(f)
+    wu_lm = np.stack([proj(2.0 * vsuu * gu_pt[i] + vsud * gd_pt[i]) for i in range(3)])
+    wd_lm = np.stack([proj(2.0 * vsdd * gd_pt[i] + vsud * gu_pt[i]) for i in range(3)])
+    vu = vu - to_pt(divergence_lm_real(wu_lm, r))
+    vd = vd - to_pt(divergence_lm_real(wd_lm, r))
+    if mag_lm is None:
+        return sht.to_lm(0.5 * (vu + vd)), sht.to_lm(e), None
+    return (
+        sht.to_lm(0.5 * (vu + vd)),
+        sht.to_lm(e),
+        sht.to_lm(0.5 * (vu - vd)),
+    )
+
 
 def mt_xc(rho_lm, r, xc, sht: MtSht, mag_lm=None):
     """(vxc_lm [lmmax_pot, nr], exc_lm [lmmax_pot, nr], bxc_lm | None).
 
-    LDA-level muffin-tin XC (the FP decks wired so far are LDA; the GGA
-    extension adds sigma terms on the same grid). Collinear magnetism via
-    mag_lm (z-component in real harmonics)."""
+    Muffin-tin XC on the angular quadrature: LDA directly; GGA via
+    mt_xc_gga. Collinear magnetism via mag_lm (z-component in real
+    harmonics)."""
     import jax.numpy as jnp
 
     if xc.is_gga:
-        raise NotImplementedError(
-            "FP-LAPW muffin-tin XC is LDA-only so far; GGA needs the MT "
-            "density gradient (reference xc_mt.cpp GGA branch)"
-        )
+        return mt_xc_gga(rho_lm, r, xc, sht, mag_lm)
 
     rho_pt = np.maximum(sht.to_grid(rho_lm), 1e-12)  # [np, nr]
     if mag_lm is None:
@@ -80,24 +138,77 @@ def mt_xc(rho_lm, r, xc, sht: MtSht, mag_lm=None):
     )
 
 
-def interstitial_xc(rho_r, xc, mag_r=None):
+def gcart_box(dims, lattice) -> np.ndarray:
+    """[3, n1, n2, n3] cartesian G of every FFT-box frequency (for full-box
+    spectral gradients in the interstitial GGA)."""
+    recip = 2.0 * np.pi * np.linalg.inv(np.asarray(lattice)).T  # rows b_i
+    freqs = [np.fft.fftfreq(n, d=1.0 / n) for n in dims]
+    m = np.stack(np.meshgrid(*freqs, indexing="ij"), axis=-1)  # [n1,n2,n3,3]
+    return np.einsum("xyzi,ij->jxyz", m, recip)
+
+
+def _box_grad(f_r, gbox):
+    fg = np.fft.fftn(f_r)
+    return np.stack(
+        [np.real(np.fft.ifftn(1j * gbox[i] * fg)) for i in range(3)]
+    )
+
+
+def _box_div(vec_r, gbox):
+    out = np.zeros(vec_r.shape[1:])
+    for i in range(3):
+        out += np.real(np.fft.ifftn(1j * gbox[i] * np.fft.fftn(vec_r[i])))
+    return out
+
+
+def interstitial_xc(rho_r, xc, mag_r=None, gbox=None):
     """(vxc_r, exc_density_r[, bxc_r]) pointwise on the FFT grid (full
     cell; the integrals later weight by the step function). Collinear
-    magnetism via mag_r (z-component)."""
+    magnetism via mag_r (z-component). GGA needs gbox (gcart_box) for the
+    full-box spectral gradient and the -div(vsigma grad n) term — exactly
+    the PP-PW smooth-grid scheme (reference xc.cpp GGA branch)."""
     import jax.numpy as jnp
 
     shape = rho_r.shape
     rho = np.maximum(rho_r, 1e-12)
+    if xc.is_gga and gbox is None:
+        raise ValueError("interstitial_xc: GGA functional requires gbox")
     if mag_r is None:
-        res = xc.evaluate(jnp.asarray(rho.ravel()))
-        v = np.asarray(res["v"]).reshape(shape)
+        if xc.is_gga:
+            g = _box_grad(rho_r, gbox)
+            sigma = np.sum(g * g, axis=0)
+            res = xc.evaluate(jnp.asarray(rho.ravel()), jnp.asarray(sigma.ravel()))
+            v = np.asarray(res["v"]).reshape(shape)
+            vs = np.asarray(res["vsigma"]).reshape(shape)
+            v = v - _box_div(2.0 * vs[None] * g, gbox)
+        else:
+            res = xc.evaluate(jnp.asarray(rho.ravel()))
+            v = np.asarray(res["v"]).reshape(shape)
         e = np.asarray(res["e"]).reshape(shape)
         return v, e
     m = np.clip(mag_r, -rho + 1e-12, rho - 1e-12)
-    res = xc.evaluate_polarized(
-        jnp.asarray((0.5 * (rho + m)).ravel()), jnp.asarray((0.5 * (rho - m)).ravel())
-    )
-    vu = np.asarray(res["v_up"]).reshape(shape)
-    vd = np.asarray(res["v_dn"]).reshape(shape)
+    up, dn = 0.5 * (rho + m), 0.5 * (rho - m)
+    if xc.is_gga:
+        gu = _box_grad(up, gbox)
+        gd = _box_grad(dn, gbox)
+        suu = np.sum(gu * gu, axis=0)
+        sud = np.sum(gu * gd, axis=0)
+        sdd = np.sum(gd * gd, axis=0)
+        res = xc.evaluate_polarized(
+            jnp.asarray(up.ravel()), jnp.asarray(dn.ravel()),
+            jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()),
+            jnp.asarray(sdd.ravel()),
+        )
+        vu = np.asarray(res["v_up"]).reshape(shape)
+        vd = np.asarray(res["v_dn"]).reshape(shape)
+        vsuu = np.asarray(res["vsigma_uu"]).reshape(shape)
+        vsud = np.asarray(res["vsigma_ud"]).reshape(shape)
+        vsdd = np.asarray(res["vsigma_dd"]).reshape(shape)
+        vu = vu - _box_div(2.0 * vsuu[None] * gu + vsud[None] * gd, gbox)
+        vd = vd - _box_div(2.0 * vsdd[None] * gd + vsud[None] * gu, gbox)
+    else:
+        res = xc.evaluate_polarized(jnp.asarray(up.ravel()), jnp.asarray(dn.ravel()))
+        vu = np.asarray(res["v_up"]).reshape(shape)
+        vd = np.asarray(res["v_dn"]).reshape(shape)
     e = np.asarray(res["e"]).reshape(shape)
     return 0.5 * (vu + vd), e, 0.5 * (vu - vd)

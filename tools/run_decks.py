@@ -51,7 +51,15 @@ def run_deck(name: str) -> dict:
 
     base = os.path.join(VER, name)
     cfg = load_config(os.path.join(base, "sirius.json"))
-    ref = json.load(open(os.path.join(base, "output_ref.json")))["ground_state"]
+    ref_full = json.load(open(os.path.join(base, "output_ref.json")))
+    ref = ref_full["ground_state"]
+    # replay numerical-definition settings the reference RECORDED for this
+    # run: some outputs were generated with a different
+    # settings.pseudo_grid_cutoff than today's schema default (test04: 8.0
+    # vs 10.0 — a real 1e-5-class energy difference in the vloc integral)
+    rec = ref_full.get("context", {}).get("config", {}).get("settings", {})
+    if "pseudo_grid_cutoff" in rec:
+        cfg.settings.pseudo_grid_cutoff = float(rec["pseudo_grid_cutoff"])
     t0 = time.time()
     if cfg.parameters.electronic_structure_method == "full_potential_lapwlo":
         from sirius_tpu.lapw.scf_fp import run_scf_fp
