@@ -2,7 +2,7 @@
 # Keep trying to capture a TPU bench timing; run for the whole session.
 # Success for 'full' ends the loop (best possible evidence captured).
 cd /root/repo
-for i in $(seq 1 20); do
+for i in $(seq 1 200); do
   echo "[capture $i] $(date)" >> /tmp/tpu_capture.log
   timeout 400 python tools/tpu_probe.py --record micro >> /tmp/tpu_capture.log 2>&1
   if [ $? -eq 0 ]; then
