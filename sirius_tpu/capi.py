@@ -213,7 +213,9 @@ def get_pw_coeffs_bytes(h: int, label: str) -> bytes:
 def set_pw_coeffs_bytes(h: int, label: str, buf: bytes) -> None:
     import numpy as np
 
-    _stepper(h).set_pw_coeffs(label, np.frombuffer(buf, dtype=np.complex128))
+    # copy: frombuffer over PyBytes is read-only, and the stepper keeps the
+    # array (in-place updates later would raise on an immutable view)
+    _stepper(h).set_pw_coeffs(label, np.frombuffer(buf, dtype=np.complex128).copy())
 
 
 def get_band_energies(h: int, ik: int, ispn: int) -> list:

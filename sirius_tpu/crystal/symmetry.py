@@ -138,6 +138,12 @@ def find_symmetry(
                     if keep_op and np.any(np.abs(moments[:, 2]) > 1e-12):
                         keep_op = abs(abs(detr * rot_cart[2, 2]) - 1.0) < 1e-6
                         spin_sign = float(np.sign(detr * rot_cart[2, 2]))
+                    else:
+                        # zero starting moments: the reference decouples spin
+                        # from space and picks the identity spin rotation
+                        # (crystal_symmetry.cpp jsym loop) — never flip the
+                        # (about-to-develop) polarization with a snapped sign
+                        spin_sign = 1.0
                 else:
                     keep_op = np.allclose(mrot, moments[perm], atol=1e-4)
                 if not keep_op:

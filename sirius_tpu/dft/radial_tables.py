@@ -38,7 +38,11 @@ def _truncate(r: np.ndarray, rc: float) -> int:
     last index with r <= rc and segment(np) keeps indices [0, np), so the
     kept range STOPS one point short of that index. The truncated vloc
     integrand does not decay (the QE tail hack exists precisely because of
-    that), so a one-point difference is a ~3e-5 Ha energy shift (SrVO3)."""
+    that), so a one-point difference is a ~3e-5 Ha energy shift (SrVO3).
+    When rc lies outside the grid, index_of returns -1 and the reference
+    keeps the FULL grid (radial_integrals.cpp:264)."""
+    if rc > r[-1] or rc < r[0]:
+        return len(r)
     n = int(np.searchsorted(r, rc, side="right")) - 1
     return max(n, 2)
 

@@ -186,6 +186,15 @@ def _filtered_solve(H, O, nev, s, u, good):
     a = t.conj().T @ H @ t
     a = 0.5 * (a + a.conj().T)
     e, c = np.linalg.eigh(a)
+    if len(e) < nev:
+        # fewer good overlap directions than requested bands: pad with a
+        # large FINITE sentinel (inf would NaN-poison the Fermi bisection
+        # and the second-variation eigh) / zero vectors so every k returns
+        # exactly nev bands; far above mu, so occupation is a true zero
+        pad = nev - len(e)
+        sentinel = (e.max() if len(e) else 0.0) + 1e3
+        e = np.concatenate([e, np.full(pad, sentinel)])
+        c = np.pad(c, ((0, 0), (0, pad)))
     v = t @ c[:, :nev]
     return e[:nev], v
 

@@ -112,8 +112,10 @@ class FpContext:
             p.gk_cutoff if p.gk_cutoff > 0 else p.aw_cutoff / self.rmt.min()
         )
 
-        # fine (density/potential) G set — box holds the pw_cutoff sphere
-        fft = FFTGrid.for_cutoff(a, p.pw_cutoff)
+        # fine (density/potential) G set — the reference's exact box sizing
+        # (interstitial XC/integrals are evaluated on this box, so its size
+        # is part of the numerical definition; see FFTGrid.ref_min_grid)
+        fft = FFTGrid.ref_min_grid(a, p.pw_cutoff)
         self.gvec = Gvec.build(a, p.pw_cutoff, fft=fft)
         self.dims = fft.dims
         self.theta_g = step_function_g(
