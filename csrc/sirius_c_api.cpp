@@ -526,4 +526,230 @@ void sirius_get_result_json(void* handler, char* buf, int buf_len,
     PyGILState_Release(st);
 }
 
+
+/* ---- option introspection (reference sirius_option_get_* family) ---- */
+
+void sirius_option_get_number_of_sections(int* length, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    if (!ensure_python()) { set_err(error_code, 1); return; }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("option_get_number_of_sections", PyTuple_New(0));
+    if (r) { *length = (int)PyLong_AsLong(r); Py_DECREF(r); set_err(error_code, 0); }
+    else   { set_err(error_code, 1); }
+    PyGILState_Release(st);
+}
+
+static void copy_str(PyObject* r, char* out, int out_len)
+{
+    const char* s = PyUnicode_AsUTF8(r);
+    std::snprintf(out, (size_t)out_len, "%s", s ? s : "");
+}
+
+void sirius_option_get_section_name(int elem, char* section_name, int section_name_length, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    if (!ensure_python()) { set_err(error_code, 1); return; }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("option_get_section_name", Py_BuildValue("(i)", elem));
+    if (r) { copy_str(r, section_name, section_name_length); Py_DECREF(r); set_err(error_code, 0); }
+    else   { set_err(error_code, 1); }
+    PyGILState_Release(st);
+}
+
+void sirius_option_get_section_length(char const* section, int* length, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    if (!ensure_python()) { set_err(error_code, 1); return; }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("option_get_section_length", Py_BuildValue("(s)", section));
+    if (r) { *length = (int)PyLong_AsLong(r); Py_DECREF(r); set_err(error_code, 0); }
+    else   { set_err(error_code, 1); }
+    PyGILState_Release(st);
+}
+
+void sirius_option_get_info(char const* section, int elem, char* key_name, int key_name_len,
+                            int* type, int* length, int* enum_size, char* title, int title_len,
+                            char* description, int description_len, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    if (!ensure_python()) { set_err(error_code, 1); return; }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("option_get_info", Py_BuildValue("(si)", section, elem));
+    if (r && PyDict_Check(r)) {
+        copy_str(PyDict_GetItemString(r, "name"), key_name, key_name_len);
+        *type = (int)PyLong_AsLong(PyDict_GetItemString(r, "type"));
+        *length = (int)PyLong_AsLong(PyDict_GetItemString(r, "length"));
+        *enum_size = (int)PyLong_AsLong(PyDict_GetItemString(r, "enum_size"));
+        copy_str(PyDict_GetItemString(r, "title"), title, title_len);
+        copy_str(PyDict_GetItemString(r, "description"), description, description_len);
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+/* ---- per-k G+k arrays (reference sirius_get_gkvec_arrays) ---- */
+
+void sirius_get_gkvec_arrays(void* handler, int const* ik, int* num_gkvec, int* gvec_index,
+                             double* gkvec, double* gkvec_cart, double* gkvec_len,
+                             double* gkvec_tp, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_gkvec_arrays",
+                       Py_BuildValue("(li)", reinterpret_cast<long>(handler), *ik));
+    if (r && PyDict_Check(r)) {
+        int n = (int)PyLong_AsLong(PyDict_GetItemString(r, "num_gkvec"));
+        *num_gkvec = n;
+        PyObject* gi = PyDict_GetItemString(r, "gvec_index");
+        PyObject* gf = PyDict_GetItemString(r, "gkvec");
+        PyObject* gc = PyDict_GetItemString(r, "gkvec_cart");
+        PyObject* gl = PyDict_GetItemString(r, "gkvec_len");
+        PyObject* gt = PyDict_GetItemString(r, "gkvec_tp");
+        for (int i = 0; i < n; i++) {
+            gvec_index[i] = (int)PyLong_AsLong(PyList_GetItem(gi, i));
+            gkvec_len[i] = PyFloat_AsDouble(PyList_GetItem(gl, i));
+            for (int x = 0; x < 3; x++) {
+                gkvec[3 * i + x] = PyFloat_AsDouble(PyList_GetItem(gf, 3 * i + x));
+                gkvec_cart[3 * i + x] = PyFloat_AsDouble(PyList_GetItem(gc, 3 * i + x));
+            }
+            for (int x = 0; x < 2; x++) {
+                gkvec_tp[2 * i + x] = PyFloat_AsDouble(PyList_GetItem(gt, 2 * i + x));
+            }
+        }
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+/* ---- real-space grid values (reference sirius_set/get_rg_values;
+ * single-process embedding: the whole Fortran-ordered box) ---- */
+
+void sirius_get_rg_dims(void* handler, int* dims, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_rg_dims", Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
+    if (r && PyList_Check(r)) {
+        for (int i = 0; i < 3; i++) dims[i] = (int)PyLong_AsLong(PyList_GetItem(r, i));
+        set_err(error_code, 0);
+    } else { set_err(error_code, 1); }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_get_rg_values(void* handler, char const* label, double* values, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("get_rg_values_bytes",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler), label));
+    if (r && PyBytes_Check(r)) {
+        std::memcpy(values, PyBytes_AsString(r), (size_t)PyBytes_Size(r));
+        set_err(error_code, 0);
+    } else { set_err(error_code, 1); }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_set_rg_values(void* handler, char const* label, double const* values,
+                          int const* num_points, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* buf = PyBytes_FromStringAndSize(reinterpret_cast<char const*>(values),
+                                              (Py_ssize_t)(*num_points) * 8);
+    PyObject* r = call("set_rg_values_bytes",
+                       Py_BuildValue("(lsO)", reinterpret_cast<long>(handler), label, buf));
+    Py_XDECREF(buf);
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+/* ---- checkpointing (reference sirius_save_state / sirius_load_state) ---- */
+
+void sirius_save_state(void* handler, char const* file_name, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("save_state",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler), file_name));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_load_state(void* handler, char const* file_name, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("load_state",
+                       Py_BuildValue("(ls)", reinterpret_cast<long>(handler), file_name));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+/* ---- Sternheimer linear solver (reference sirius_linear_solver) ---- */
+
+void sirius_linear_solver(void* handler, double const* vkq, int const* num_gvec_kq_loc,
+                          int const* gvec_kq_loc, double* dpsi /* complex */, double* psi,
+                          double* eigvals, double* dvpsi, int const* ld,
+                          int const* num_spin_comp, double const* alpha_pv, int const* spin,
+                          int const* nbnd_occ_k, int const* nbnd_occ_kq, double const* tol,
+                          int* niter, int* error_code)
+{
+    (void)num_gvec_kq_loc; (void)gvec_kq_loc; (void)nbnd_occ_kq; (void)num_spin_comp;
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    int n_col = *nbnd_occ_k;
+    Py_ssize_t nb_bytes = (Py_ssize_t)(*ld) * n_col * 16;
+    PyObject* vkq_t = Py_BuildValue("(ddd)", vkq[0], vkq[1], vkq[2]);
+    PyObject* b_dpsi = PyBytes_FromStringAndSize(reinterpret_cast<char*>(dpsi), nb_bytes);
+    PyObject* b_psi = PyBytes_FromStringAndSize(reinterpret_cast<char*>(psi), nb_bytes);
+    PyObject* b_ev = PyBytes_FromStringAndSize(reinterpret_cast<char*>(eigvals),
+                                               (Py_ssize_t)n_col * 8);
+    PyObject* b_dv = PyBytes_FromStringAndSize(reinterpret_cast<char*>(dvpsi), nb_bytes);
+    PyObject* r = call("linear_solver_bytes",
+                       Py_BuildValue("(lOOOOOiidiiid)", reinterpret_cast<long>(handler),
+                                     vkq_t, b_dpsi, b_psi, b_ev, b_dv, *ld, 1,
+                                     *alpha_pv, *spin, *nbnd_occ_k, *nbnd_occ_kq,
+                                     tol ? *tol : 1e-8));
+    Py_XDECREF(vkq_t); Py_XDECREF(b_dpsi); Py_XDECREF(b_psi);
+    Py_XDECREF(b_ev); Py_XDECREF(b_dv);
+    if (r && PyBytes_Check(r)) {
+        std::memcpy(dpsi, PyBytes_AsString(r), (size_t)PyBytes_Size(r));
+        if (niter) *niter = 0;
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+/* ---- host callbacks (reference sirius_set_callback_function): the
+ * pointers are registered and invoked from the python side through
+ * ctypes when the matching radial-integral path runs ---- */
+
+void sirius_set_callback_function(void* handler, char const* fn_name, void (*fn_ptr)(void),
+                                  int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_callback_function",
+                       Py_BuildValue("(lsl)", reinterpret_cast<long>(handler), fn_name,
+                                     reinterpret_cast<long>(fn_ptr)));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
 } /* extern "C" */

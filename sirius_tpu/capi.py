@@ -266,3 +266,257 @@ def get_scalar(h: int, name: str) -> float:
 
 def get_json(h: int) -> str:
     return json.dumps(_result(h), default=float)
+
+
+# ---- option introspection (reference sirius_option_get_* family; drives
+# CP2K's input autogeneration) — the registry is derived from the typed
+# config dataclasses in config/schema.py ----
+
+_OPTION_TYPE = {  # reference option_type_t codes (sirius_api.cpp:178)
+    int: 1, float: 2, bool: 3, str: 4,
+    "int_array": 11, "double_array": 12, "bool_array": 13, "string_array": 14,
+}
+
+
+def _option_sections() -> dict:
+    import dataclasses as _dc
+
+    from sirius_tpu.config import schema as _s
+
+    out = {}
+    for sec, cls in (
+        ("control", _s.ControlConfig),
+        ("parameters", _s.ParametersConfig),
+        ("iterative_solver", _s.IterativeSolverConfig),
+        ("mixer", _s.MixerConfig),
+        ("settings", _s.SettingsConfig),
+        ("hubbard", _s.HubbardConfig),
+        ("unit_cell", _s.UnitCellConfig),
+    ):
+        entries = []
+        for f in _dc.fields(cls):
+            t = f.type if isinstance(f.type, type) else None
+            default = None
+            if f.default is not _dc.MISSING:
+                default = f.default
+            elif f.default_factory is not _dc.MISSING:  # type: ignore[misc]
+                default = f.default_factory()
+            if t is None:
+                t = type(default) if default is not None else str
+            if isinstance(default, list):
+                code = _OPTION_TYPE["double_array"]
+                if default and isinstance(default[0], int):
+                    code = _OPTION_TYPE["int_array"]
+                elif default and isinstance(default[0], str):
+                    code = _OPTION_TYPE["string_array"]
+            else:
+                code = _OPTION_TYPE.get(t, 4)
+            entries.append({
+                "name": f.name,
+                "type": code,
+                "default": default,
+                "length": len(default) if isinstance(default, list) else 1,
+            })
+        out[sec] = entries
+    return out
+
+
+def option_get_number_of_sections() -> int:
+    return len(_option_sections())
+
+
+def option_get_section_name(i: int) -> str:
+    return list(_option_sections().keys())[int(i) - 1]
+
+
+def option_get_section_length(section: str) -> int:
+    return len(_option_sections()[section.lower()])
+
+
+def option_get_info(section: str, elem: int) -> dict:
+    e = _option_sections()[section.lower()][int(elem) - 1]
+    return {
+        "name": e["name"], "type": e["type"], "length": e["length"],
+        "enum_size": 0,
+        "title": e["name"].replace("_", " "),
+        "description": f"{section}.{e['name']} (default: {e['default']!r})",
+    }
+
+
+def option_get(section: str, name: str) -> object:
+    for e in _option_sections()[section.lower()]:
+        if e["name"] == name.lower():
+            return e["default"]
+    raise KeyError(f"{section}.{name}")
+
+
+# ---- k-point / G-vector array access (reference sirius_get_gkvec_arrays,
+# sirius_api.cpp:4024) ----
+
+
+def get_num_gkvec(h: int, ik: int) -> int:
+    import numpy as np
+
+    st = _stepper(h)
+    return int(np.sum(np.asarray(st.ctx.gkvec.mask[int(ik) - 1]) > 0))
+
+
+def get_gkvec_arrays(h: int, ik: int) -> dict:
+    """Fortran-ordered flat arrays for one k (1-based ik): fractional G+k,
+    cartesian, lengths, (theta, phi)."""
+    import numpy as np
+
+    st = _stepper(h)
+    gk = st.ctx.gkvec
+    i = int(ik) - 1
+    m = np.asarray(gk.mask[i]) > 0
+    frac = (np.asarray(gk.millers[i]) + np.asarray(gk.kpoints[i]))[m]
+    cart = np.asarray(gk.gkcart[i])[m]
+    ln = np.linalg.norm(cart, axis=1)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        theta = np.where(ln > 1e-12, np.arccos(np.clip(cart[:, 2] / np.maximum(ln, 1e-30), -1, 1)), 0.0)
+        phi = np.arctan2(cart[:, 1], cart[:, 0])
+    return {
+        "num_gkvec": int(m.sum()),
+        "gvec_index": (np.nonzero(m)[0] + 1).tolist(),
+        "gkvec": frac.ravel().tolist(),
+        "gkvec_cart": cart.ravel().tolist(),
+        "gkvec_len": ln.tolist(),
+        "gkvec_tp": np.stack([theta, phi], axis=1).ravel().tolist(),
+    }
+
+
+# ---- real-space grid access (reference sirius_set/get_rg_values);
+# single-process embedding: the whole box in Fortran (column-major) order --
+
+
+def get_rg_values_bytes(h: int, label: str) -> bytes:
+    import numpy as np
+
+    st = _stepper(h)
+    f_r = st.get_rg_values(label)  # [n1, n2, n3] real
+    return np.asfortranarray(f_r).tobytes(order="F")
+
+
+def set_rg_values_bytes(h: int, label: str, buf: bytes) -> None:
+    import numpy as np
+
+    st = _stepper(h)
+    dims = st.rg_dims()
+    vals = np.frombuffer(buf, dtype=np.float64).reshape(dims, order="F").copy()
+    st.set_rg_values(label, vals)
+
+
+def get_rg_dims(h: int) -> list:
+    return list(_stepper(h).rg_dims())
+
+
+# ---- state save/load (reference sirius_save_state/sirius_load_state) ----
+
+
+def save_state(h: int, path: str) -> None:
+    _stepper(h).save_state(path)
+
+
+def load_state(h: int, path: str) -> None:
+    _stepper(h).load_state(path)
+
+
+# ---- Sternheimer linear solver (reference sirius_linear_solver,
+# sirius_api.cpp:6101 — the QE DFPT hook, backed by solvers/multi_cg) ----
+
+
+def linear_solver_bytes(h: int, vkq, dpsi: bytes, psi: bytes, eigvals: bytes,
+                        dvpsi: bytes, ld: int, num_spin_comp: int,
+                        alpha_pv: float, spin: int, nbnd_occ_k: int,
+                        nbnd_occ_kq: int, tol: float) -> bytes:
+    """Solve (H + alpha_pv P - eps_n S) |dpsi_n> = -|dvpsi_n> for the
+    occupied bands; returns the updated dpsi buffer."""
+    import numpy as np
+
+    st = _stepper(h)
+    n = int(nbnd_occ_k)
+    ldi = int(ld)
+    if n == 0:
+        return dpsi
+    psi_a = np.frombuffer(psi, dtype=np.complex128).reshape(ldi, -1, order="F")
+    dv_a = np.frombuffer(dvpsi, dtype=np.complex128).reshape(ldi, -1, order="F").copy()
+    ev = np.frombuffer(eigvals, dtype=np.float64)
+    out = st.linear_solver(
+        np.asarray(vkq, dtype=np.float64), psi_a[:, :n], ev[:n], dv_a[:, :n],
+        alpha_pv=float(alpha_pv), spin=int(spin), tol=float(tol),
+    )
+    dp = np.frombuffer(dpsi, dtype=np.complex128).reshape(ldi, -1, order="F").copy()
+    dp[:, :n] = out
+    return np.asfortranarray(dp).tobytes(order="F")
+
+
+# ---- host callbacks (reference sirius_set_callback_function +
+# callback_functions_t, simulation_context.hpp:64-102). Pointers are
+# invoked through ctypes; the supported hooks are consulted by the
+# radial-integral tables (dft/radial_tables.py) when set. ----
+
+_CALLBACK_SIGS = {
+    # name -> argument ctypes builder (reference signatures)
+    "vloc_ri": "ri_iq",        # void(int iat, int nq, double* q, double* out)
+    "rhoc_ri": "ri_iq",
+    "ps_rho_ri": "ri_iq",
+    "beta_ri": "ri_lq",        # void(int idx, double q, double* out, int n)
+    "ps_atomic_wf_ri": "ri_lq",
+    "aug_ri": "ri_lq2",        # void(int idx, double q, double* out, int n1, int n2)
+}
+
+
+def set_callback_function(h: int, name: str, ptr: int) -> None:
+    import ctypes
+
+    name = name.strip().lower()
+    kind = _CALLBACK_SIGS.get(name)
+    if kind is None:
+        # accept-and-ignore unknown hooks (reference tolerates unused ones)
+        _handles[int(h)].setdefault("callbacks", {})[name] = None
+        return
+    if kind == "ri_iq":
+        ftype = ctypes.CFUNCTYPE(
+            None, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        )
+    elif kind == "ri_lq":
+        ftype = ctypes.CFUNCTYPE(
+            None, ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        )
+    else:
+        ftype = ctypes.CFUNCTYPE(
+            None, ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+        )
+    _handles[int(h)].setdefault("callbacks", {})[name] = ftype(int(ptr))
+    # the vloc/rhoc/ps_rho hooks replace the form-factor tables globally
+    # for contexts created from this handle
+    from sirius_tpu.dft import radial_tables as _rt
+
+    inv = _make_ri_invoker(_handles[int(h)]["callbacks"][name], kind)
+    if inv is not None:  # only the ri_iq hooks have a consumer path so far
+        _rt.HOST_CALLBACKS[name] = inv
+
+
+def _make_ri_invoker(cfn, kind):
+    import ctypes
+
+    import numpy as np
+
+    if kind == "ri_iq":
+        def invoke(iat: int, q: np.ndarray) -> np.ndarray:
+            q = np.ascontiguousarray(q, dtype=np.float64)
+            out = np.zeros_like(q)
+            ia = ctypes.c_int(int(iat))
+            nq = ctypes.c_int(len(q))
+            cfn(
+                ctypes.byref(ia), ctypes.byref(nq),
+                q.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            )
+            return out
+        return invoke
+    return None

@@ -20,7 +20,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--task",
         default="ground_state_new",
-        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "k_point_path"],
+        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "ground_state_direct", "k_point_path"],
         help="calculation task (reference sirius.scf task semantics)",
     )
     p.add_argument(

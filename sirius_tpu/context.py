@@ -107,10 +107,15 @@ class SimulationContext:
             beta = dataclasses.replace(beta, qmat=qmat)
         sfact = structure_factors(uc, gvec)
         vloc_g = make_periodic_function(
-            uc, gvec, vloc_ff(cfg.settings.pseudo_grid_cutoff), sfact
+            uc, gvec, vloc_ff(cfg.settings.pseudo_grid_cutoff), sfact,
+            hook="vloc_ri",
         )
-        rho_core_g = make_periodic_function(uc, gvec, rho_core_form_factor, sfact)
-        rho_at_g = make_periodic_function(uc, gvec, rho_total_form_factor, sfact)
+        rho_core_g = make_periodic_function(
+            uc, gvec, rho_core_form_factor, sfact, hook="rhoc_ri"
+        )
+        rho_at_g = make_periodic_function(
+            uc, gvec, rho_total_form_factor, sfact, hook="ps_rho_ri"
+        )
 
         e_ewald = ewald_energy(
             uc.lattice,

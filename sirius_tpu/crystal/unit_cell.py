@@ -51,6 +51,13 @@ class UnitCell:
         for lbl in uc.atom_types:
             fname = uc.atom_files.get(lbl, "")
             path = fname if os.path.isabs(fname) else os.path.join(base_dir, fname)
+            if (not path.lower().endswith(".json")) and os.path.exists(path + ".json"):
+                # decks may reference a raw UPF name with a converted
+                # <name>.json alongside; prefer the JSON (the converter in
+                # tools/upf_to_json.py produces the same layout)
+                path = path + ".json"
+            elif not os.path.exists(path) and os.path.exists(path + ".json"):
+                path = path + ".json"
             types.append(AtomType.from_file(lbl, path))
             type_index[lbl] = len(types) - 1
         t_of_a, pos, mom = [], [], []

@@ -103,15 +103,33 @@ def structure_factors(uc: UnitCell, gvec: Gvec) -> np.ndarray:
 
 
 def make_periodic_function(
-    uc: UnitCell, gvec: Gvec, form_factor_fn, sfact: np.ndarray | None = None
+    uc: UnitCell, gvec: Gvec, form_factor_fn, sfact: np.ndarray | None = None,
+    hook: str | None = None,
 ) -> np.ndarray:
     """f(G) = (4 pi / Omega) sum_t ff_t(|G|) conj(S_t(G)), evaluated on
-    shells then scattered to the full G array."""
+    shells then scattered to the full G array.
+
+    hook: name of a host radial-integral callback (C API
+    sirius_set_callback_function); when registered in HOST_CALLBACKS the
+    host's integrals replace form_factor_fn for every atom type."""
     if sfact is None:
         sfact = structure_factors(uc, gvec)
     qshell = np.sqrt(gvec.shell_g2)
+    cb = HOST_CALLBACKS.get(hook) if hook else None
     f = np.zeros(gvec.num_gvec, dtype=np.complex128)
     for it, at in enumerate(uc.atom_types):
-        ff_shell = np.asarray(form_factor_fn(at, qshell))
+        if cb is not None:
+            # reference callback convention: 1-based atom-type index
+            ff_shell = np.asarray(cb(it + 1, qshell))
+        else:
+            ff_shell = np.asarray(form_factor_fn(at, qshell))
         f += ff_shell[gvec.shell_idx] * np.conj(sfact[it])
     return f * (4.0 * np.pi / uc.omega)
+
+
+# Host-code radial-integral callbacks (C API sirius_set_callback_function):
+# when a hook is registered the host's integrals REPLACE the built-in
+# form-factor evaluation (reference callback_functions_t usage in
+# radial_integrals.cpp). Keyed by hook name; values are
+# invoke(iat, q[nq]) -> values[nq] callables.
+HOST_CALLBACKS: dict = {}

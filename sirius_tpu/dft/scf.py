@@ -151,32 +151,43 @@ def run_scf(
 
     from sirius_tpu.ops.hubbard import (
         HubbardData,
+        constraint_reference_matrix,
+        constraint_update,
         hubbard_potential_and_energy,
+        initial_occupancy,
         occupation_matrix,
+        register_sym_ops,
         symmetrize_occupation,
+        u_matrix_for_k,
     )
 
     hub = HubbardData.build(ctx)
-    vhub = None
+    vhub = None  # per-k apply matrices [nk, ns, nhub, nhub] (or None)
+    um_nl: list = []
+    om_nl = None
+    hub_lagrange = None
+    hub_om_cons = None
     e_hub = e_hub_one_el = 0.0
     if hub is not None:
-        # initial occupation guess: even diagonal filling of the shell
-        by_label = {e["atom_type"]: e for e in cfg.hubbard.local}
-        n0 = np.zeros((ns, hub.num_hub_total, hub.num_hub_total), dtype=np.complex128)
-        for ia, off, nm, u_eff, alpha, l in hub.blocks:
-            occ0 = float(
-                by_label[ctx.unit_cell.atom_types[ctx.unit_cell.type_of_atom[ia]].label]
-                .get("total_initial_occupancy", nm)
-            )
-            for ispn in range(ns):
-                # scaled convention: <= 1 per (m, spin channel)
-                np.fill_diagonal(
-                    n0[ispn, off : off + nm, off : off + nm],
-                    min(1.0, occ0 / 2.0 / nm),
-                )
-        vhub, e_hub, e_hub_one_el = hubbard_potential_and_energy(
-            hub, n0, ctx.max_occupancy
+        register_sym_ops(hub, ctx)
+        n0 = initial_occupancy(ctx, hub, ns)
+        hub_om_cons = constraint_reference_matrix(hub, ns)
+        if hub_om_cons is not None:
+            # constrained blocks start AT the target occupancy (reference
+            # Occupation_matrix::init constrained_calculation branch)
+            n0 = np.where(np.abs(hub_om_cons) > 0, hub_om_cons, n0)
+        om_nl0 = [
+            np.zeros((ns, 2 * e["il"] + 1, 2 * e["jl"] + 1), dtype=np.complex128)
+            for e in hub.nonloc
+        ]
+        um_local, um_nl, e_hub, e_hub_one_el = hubbard_potential_and_energy(
+            hub, n0, ctx.max_occupancy, om_nl=om_nl0,
+            lagrange=hub_lagrange, om_cons=hub_om_cons,
         )
+        vhub = np.stack([
+            u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
+            for ik in range(nk)
+        ])
 
     # --- PAW on-site machinery (dft/paw.py; None when no PAW species) ---
     from sirius_tpu.dft import paw as paw_mod
@@ -223,11 +234,15 @@ def run_scf(
         # the initial potential exists (reference initialize_subspace)
         psi_big = _initial_subspace(ctx)
     om_size = 0 if hub is None else ns * hub.num_hub_total * hub.num_hub_total
+    nl_sizes = [] if hub is None else [
+        ns * (2 * e["il"] + 1) * (2 * e["jl"] + 1) for e in hub.nonloc
+    ]
+    nl_size = sum(nl_sizes)
     paw_size = 0 if paw is None else paw.dm_size()
     mixer = Mixer(
         cfg.mixer, ctx.gvec.glen2,
         num_components=2 if polarized else 1,
-        extra_len=om_size + paw_size,
+        extra_len=om_size + nl_size + paw_size,
         omega=ctx.unit_cell.omega,
     )
     # constant device tables, uploaded once (not per iteration); the full-
@@ -306,12 +321,14 @@ def run_scf(
 
     ng = ctx.gvec.num_gvec
 
-    def pack(r, m, o, pdm):
+    def pack(r, m, o, onl, pdm):
         parts = [r]
         if polarized:
             parts.append(m)
         if hub is not None:
             parts.append(o.ravel())
+            for blk in onl or []:
+                parts.append(blk.ravel())
         if paw is not None:
             parts.append(pdm.astype(np.complex128))
         return np.concatenate(parts) if len(parts) > 1 else r
@@ -320,21 +337,47 @@ def run_scf(
         r = x[:ng]
         m = x[ng : 2 * ng] if polarized else None
         o = None
+        onl = None
         pdm = None
         if paw is not None:
             pdm = np.real(x[len(x) - paw_size :])
         end = len(x) - paw_size
         if hub is not None:
-            o = x[end - om_size : end].reshape(
+            start = end - om_size - nl_size
+            o = x[start : start + om_size].reshape(
                 ns, hub.num_hub_total, hub.num_hub_total
             )
-        return r, m, o, pdm
+            onl = []
+            off = start + om_size
+            for e, sz in zip(hub.nonloc, nl_sizes):
+                onl.append(
+                    x[off : off + sz].reshape(ns, 2 * e["il"] + 1, 2 * e["jl"] + 1)
+                )
+                off += sz
+        return r, m, o, onl, pdm
 
     om_mixed = n0 if hub is not None else None
-    x_mix = pack(rho_g, mag_g, om_mixed, paw_dm)
+    om_nl_mixed = om_nl0 if hub is not None else None
+    x_mix = pack(rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm)
 
     evals = np.zeros((nk, ns, nb))
     pr = pi = None  # batched-path device-resident (re, im) wave functions
+    # production multi-device mesh: k-points over "k", bands over "b"
+    # (GSPMD — same program, XLA inserts the collectives; None on 1 device)
+    from sirius_tpu.parallel.mesh import place_kset_params, production_mesh
+
+    scf_mesh, psi_spec = (None, None) if serial_bands else production_mesh(nk, nb)
+    if scf_mesh is not None:
+        from jax.sharding import NamedSharding
+
+        _psi_sharding = NamedSharding(scf_mesh, psi_spec)
+
+        def _place_psi(x):
+            return jax.device_put(x, _psi_sharding)
+    else:
+
+        def _place_psi(x):
+            return x
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
     etot_history, rms_history, mag_history = [], [], []
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
@@ -371,7 +414,7 @@ def run_scf(
                             params = hk_params(
                                 ik, pot.veff_r_coarse[ispn], d_by_spin[ispn],
                                 wf_dtype,
-                                vhub_s=None if vhub is None else vhub[ispn],
+                                vhub_s=None if vhub is None else vhub[ik, ispn],
                             )
                             xb = psi_big[ik, ispn] * np.asarray(ctx.gkvec.mask[ik])
                             hx, sx = apply_h_s(params, jnp.asarray(xb, dtype=wf_dtype))
@@ -392,7 +435,7 @@ def run_scf(
 
                         params = hk_params(
                             ik, pot.veff_r_coarse[ispn], d_by_spin[ispn], wf_dtype,
-                            vhub_s=None if vhub is None else vhub[ispn],
+                            vhub_s=None if vhub is None else vhub[ik, ispn],
                         )
                         h_diag, o_diag = _h_o_diag(ctx, ik, v0, d_by_spin[ispn])
                         rdt = real_dtype_of(wf_dtype)
@@ -426,6 +469,7 @@ def run_scf(
                     pot.veff_r_coarse[:ns], np.stack(d_by_spin), v0, vhub,
                     wf_dtype,
                 )
+                ps = place_kset_params(ps, scf_mesh)
                 rdt = real_dtype_of(wf_dtype)
                 if pr is None and psi is None and psi_big is not None:
                     # first iteration from a fresh LCAO block: rotate the
@@ -436,9 +480,21 @@ def run_scf(
                     )
 
                     pb_re, pb_im = split_cplx(psi_big, rdt)
+                    if scf_mesh is not None:
+                        # the LCAO block has nbig >= nb orbitals — shard it
+                        # over "k" only (nbig need not divide the band axis)
+                        from jax.sharding import (
+                            NamedSharding as _NS,
+                            PartitionSpec as _P,
+                        )
+
+                        _big = _NS(scf_mesh, _P("k", None, None, None))
+                        pb_re = jax.device_put(jnp.asarray(pb_re), _big)
+                        pb_im = jax.device_put(jnp.asarray(pb_im), _big)
                     pr, pi = initialize_subspace_kset(
                         ps, jnp.asarray(pb_re), jnp.asarray(pb_im), nb
                     )
+                    pr, pi = _place_psi(pr), _place_psi(pi)
                     counters["num_loc_op_applied"] += nk * ns * psi_big.shape[2]
                     psi_big = None
                 if pr is None or pr.dtype != np.dtype(rdt):
@@ -446,6 +502,7 @@ def run_scf(
                     # (None) if the previous iterations kept the pair only
                     src = psi if psi is not None else join_cplx(pr, pi)
                     pr, pi = split_cplx(np.asarray(src), rdt)
+                    pr, pi = _place_psi(jnp.asarray(pr)), _place_psi(jnp.asarray(pi))
                 ev, pr, pi, rn = davidson_kset(
                     ps, pr, pi,
                     num_steps=itsol.num_steps,
@@ -477,14 +534,37 @@ def run_scf(
 
         # --- Hubbard occupation matrix (mixed jointly with the density) ---
         om_new = None
+        om_nl_new = None
         if hub is not None:
-            om_new = occupation_matrix(ctx, hub, psi, occ_np, ctx.max_occupancy)
+            om_new, occ_T = occupation_matrix(
+                ctx, hub, psi, occ_np, ctx.max_occupancy
+            )
             if do_symmetrize:
-                om_new = symmetrize_occupation(ctx, hub, om_new)
-            # the one-electron term inside eval_sum used the PREVIOUS V
-            e_hub_one_el = ctx.max_occupancy * sum(
-                float(np.real(np.trace(vhub[ispn] @ om_new[ispn])))
-                for ispn in range(ns)
+                om_new, om_nl_new = symmetrize_occupation(
+                    ctx, hub, om_new, occ_T
+                )
+            else:
+                from sirius_tpu.ops.hubbard import nonlocal_from_occ_T
+
+                om_nl_new = nonlocal_from_occ_T(hub, occ_T) if hub.nonloc else []
+            # occupancy-constraint Lagrange multipliers (reference
+            # calculate_constraints_and_error, beta-mixed each iteration)
+            if hub_om_cons is not None:
+                hub_lagrange, _c_err, _ = constraint_update(
+                    hub, om_new, hub_lagrange, hub_om_cons, it
+                )
+            # one-electron term inside eval_sum: NEW occupancies against the
+            # potential the band solve actually used (um_local/um_nl of the
+            # previous mixing step; reference one_electron_energy_hubbard)
+            e_hub_one_el = ctx.max_occupancy * (
+                sum(
+                    float(np.real(np.sum(om_new[ispn] * np.conj(um_local[ispn]))))
+                    for ispn in range(ns)
+                )
+                + sum(
+                    float(np.real(np.sum(o * np.conj(u))))
+                    for o, u in zip(om_nl_new or [], um_nl)
+                )
             )
 
         # --- density (per spin, then charge/magnetization assembly) ---
@@ -540,7 +620,7 @@ def run_scf(
         paw_dm_new = (
             paw.dm_from_density_matrix(dm_by_spin) if paw is not None else None
         )
-        x_new = pack(rho_new, mag_new, om_new, paw_dm_new)
+        x_new = pack(rho_new, mag_new, om_new, om_nl_new, paw_dm_new)
         rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
         if not np.all(np.isfinite(evals)) or not np.isfinite(
             np.sum(np.abs(x_new))
@@ -552,11 +632,16 @@ def run_scf(
             )
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
-        rho_g, mag_g, om_mixed, paw_dm = unpack(x_mix)
+        rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm = unpack(x_mix)
         if hub is not None:
-            vhub, e_hub, _ = hubbard_potential_and_energy(
-                hub, om_mixed, ctx.max_occupancy
+            um_local, um_nl, e_hub, _ = hubbard_potential_and_energy(
+                hub, om_mixed, ctx.max_occupancy, om_nl=om_nl_mixed,
+                lagrange=hub_lagrange, om_cons=hub_om_cons,
             )
+            vhub = np.stack([
+                u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
+                for ik in range(nk)
+            ])
         if paw is not None:
             # PAW on-site update from the mixed dm: potentials, Dij (used by
             # the next band solve) and energies (reference generates the PAW
@@ -785,6 +870,10 @@ def run_scf_from_file(
         result["relaxation"] = {k: rr[k] for k in ("converged", "num_steps", "history", "final_positions")}
     elif task == "ground_state_restart":
         result = run_scf(cfg, base_dir, restart_from=state_file, save_to=state_file)
+    elif task == "ground_state_direct":
+        from sirius_tpu.dft.direct_min import run_direct_min
+
+        result = run_direct_min(cfg, base_dir)
     elif task == "k_point_path":
         from sirius_tpu.context import SimulationContext
         from sirius_tpu.dft.bands import band_path, sample_path

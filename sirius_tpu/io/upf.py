@@ -1,0 +1,207 @@
+"""UPF v2 (XML) pseudopotential reader -> SIRIUS-layout JSON dict.
+
+Re-implementation of the reference converter app (apps/upf/upf_to_json.py
+behavior, layout only — the parser here is written against the UPF v2
+format spec using xml.etree). Validated element-wise against the
+pre-converted <name>.UPF.json files shipped with verification/test32
+(NC, US/rrkjus and PAW/kjpaw species) in tests/test_upf.py.
+
+Unit conventions of the JSON layout (determined against those files):
+  - local_potential, D_ion, paw ae_local_potential: Ry -> Ha (x 0.5)
+  - radial grid, beta, chi, rho_atom, nlcc, augmentation Q: unchanged
+  - beta_projectors truncated at their cutoff_radius_index
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+
+def _floats(el) -> list:
+    return [float(x) for x in el.text.split()]
+
+
+def _attrib(el, name, default=None):
+    v = el.attrib.get(name, default)
+    return v.strip() if isinstance(v, str) else v
+
+
+def _bool(v) -> bool:
+    return str(v).strip().upper() in ("T", "TRUE", ".TRUE.", "1")
+
+
+def upf2_to_json(path: str) -> dict:
+    """Parse a UPF v2 file into the SIRIUS pseudo_potential JSON layout."""
+    root = ET.parse(path).getroot()
+    if root.tag != "UPF":
+        raise ValueError(f"{path}: not a UPF v2 file (root tag {root.tag})")
+    h = root.find("PP_HEADER").attrib
+
+    pp: dict = {}
+    header = {
+        "element": h["element"].strip(),
+        "pseudo_type": h["pseudo_type"].strip(),
+        "core_correction": _bool(h.get("core_correction", "F")),
+        "z_valence": float(h["z_valence"]),
+        "mesh_size": int(h["mesh_size"]),
+        "number_of_wfc": int(h.get("number_of_wfc", 0)),
+        "number_of_proj": int(h.get("number_of_proj", 0)),
+        "is_ultrasoft": _bool(h.get("is_ultrasoft", "F")),
+        "spin_orbit": _bool(h.get("has_so", "F")),
+        "original_upf_file": path.rsplit("/", 1)[-1],
+    }
+
+    r = np.asarray(_floats(root.find("PP_MESH/PP_R")))
+    pp["radial_grid"] = r.tolist()
+    vloc = root.find("PP_LOCAL")
+    if vloc is not None:
+        pp["local_potential"] = (0.5 * np.asarray(_floats(vloc))).tolist()
+    nlcc = root.find("PP_NLCC")
+    if nlcc is not None:
+        pp["core_charge_density"] = _floats(nlcc)
+    rho = root.find("PP_RHOATOM")
+    if rho is not None:
+        pp["total_charge_density"] = _floats(rho)
+
+    # --- beta projectors (truncated at their cutoff index) ---
+    nl = root.find("PP_NONLOCAL")
+    betas = []
+    nproj = header["number_of_proj"]
+    max_cri = 0
+    for i in range(1, nproj + 1):
+        b = nl.find(f"PP_BETA.{i}")
+        vals = _floats(b)
+        cri = _attrib(b, "cutoff_radius_index")
+        n = int(cri) if cri else len(vals)
+        max_cri = max(max_cri, n)
+        entry = {
+            "radial_function": vals[:n],
+            "angular_momentum": int(_attrib(b, "angular_momentum")),
+        }
+        lab = _attrib(b, "label")
+        if lab:
+            entry["label"] = lab
+        j = _attrib(b, "total_angular_momentum")
+        if j is not None and header["spin_orbit"]:
+            entry["total_angular_momentum"] = float(j)
+        betas.append(entry)
+    pp["beta_projectors"] = betas
+    dij = nl.find("PP_DIJ")
+    if dij is not None:
+        pp["D_ion"] = (0.5 * np.asarray(_floats(dij))).tolist()
+
+    # --- augmentation (US/PAW): Q_ij^l(r) with q_with_l ---
+    aug_el = nl.find("PP_AUGMENTATION")
+    if aug_el is not None and _bool(_attrib(aug_el, "q_with_l", "F")):
+        aug = []
+        ls = [b["angular_momentum"] for b in betas]
+        for i in range(nproj):
+            for j in range(i, nproj):
+                for l in range(abs(ls[i] - ls[j]), ls[i] + ls[j] + 1, 2):
+                    q = aug_el.find(f"PP_QIJL.{i + 1}.{j + 1}.{l}")
+                    if q is None:
+                        continue
+                    aug.append({
+                        "i": i,
+                        "j": j,
+                        "angular_momentum": l,
+                        "radial_function": _floats(q),
+                    })
+        pp["augmentation"] = aug
+
+    # --- atomic wave functions ---
+    wfc = root.find("PP_PSWFC")
+    wfs = []
+    if wfc is not None:
+        for i in range(1, header["number_of_wfc"] + 1):
+            c = wfc.find(f"PP_CHI.{i}")
+            if c is None:
+                continue
+            # NOTE: the reference converter keeps beta labels but DROPS the
+            # chi labels (checked against the shipped .UPF.json files)
+            wfs.append({
+                "radial_function": _floats(c),
+                "angular_momentum": int(_attrib(c, "l")),
+                "occupation": float(_attrib(c, "occupation", 0.0)),
+            })
+    pp["atomic_wave_functions"] = wfs
+
+    # --- PAW block ---
+    paw_el = root.find("PP_PAW")
+    full_wfc = root.find("PP_FULL_WFC")
+    if paw_el is not None:
+        ce = _attrib(paw_el, "core_energy")
+        if ce is not None:
+            header["paw_core_energy"] = 0.5 * float(ce)
+        cri = _attrib(aug_el, "cutoff_r_index") if aug_el is not None else None
+        header["cutoff_radius_index"] = int(cri) if cri else max_cri
+        pd: dict = {}
+        occ = paw_el.find("PP_OCCUPATIONS")
+        if occ is not None:
+            pd["occupations"] = _floats(occ)
+        ae_nlcc = paw_el.find("PP_AE_NLCC")
+        if ae_nlcc is not None:
+            pd["ae_core_charge_density"] = _floats(ae_nlcc)
+        ae_vloc = paw_el.find("PP_AE_VLOC")
+        if ae_vloc is not None:
+            pd["ae_local_potential"] = (
+                0.5 * np.asarray(_floats(ae_vloc))
+            ).tolist()
+        if full_wfc is not None:
+            ae, ps = [], []
+            for i in range(1, nproj + 1):
+                a = full_wfc.find(f"PP_AEWFC.{i}")
+                p_ = full_wfc.find(f"PP_PSWFC.{i}")
+                if a is not None:
+                    ae.append({
+                        "radial_function": _floats(a),
+                        "angular_momentum": int(_attrib(a, "l")),
+                    })
+                if p_ is not None:
+                    ps.append({
+                        "radial_function": _floats(p_),
+                        "angular_momentum": int(_attrib(p_, "l")),
+                    })
+            pd["ae_wfc"] = ae
+            pd["ps_wfc"] = ps
+        # aug integrals/multipoles from the augmentation block
+        if aug_el is not None:
+            q = aug_el.find("PP_Q")
+            if q is not None:
+                pd["aug_integrals"] = _floats(q)
+            m = aug_el.find("PP_MULTIPOLES")
+            if m is not None:
+                pd["aug_multipoles"] = _floats(m)
+        pp["paw_data"] = pd
+
+    pp["header"] = header
+    return {"pseudo_potential": pp}
+
+
+def convert(path: str, out_path: str | None = None) -> str:
+    """Convert a UPF v2 file; writes <path>.json unless out_path given."""
+    import json
+
+    data = upf2_to_json(path)
+    out = out_path or path + ".json"
+    with open(out, "w") as f:
+        json.dump(data, f)
+    return out
+
+
+def main(argv=None) -> int:
+    import sys
+
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: python -m sirius_tpu.io.upf <file.UPF> [out.json]")
+        return 2
+    out = convert(args[0], args[1] if len(args) > 1 else None)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

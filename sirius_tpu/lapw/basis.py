@@ -62,6 +62,7 @@ class AtomRadialBasis:
     lo: list
     enu: list
     lo_enu: list = dataclasses.field(default_factory=list)  # resolved, per lo
+    minv_R: float = 1.0  # 1/M(R) of the valence relativity (ZORA/IORA)
 
     def overlap(self, f1: MtRadial, f2: MtRadial) -> float:
         return float(rint(f1.f * f2.f * self.r**2, self.r))
@@ -71,16 +72,20 @@ class AtomRadialBasis:
         surface term: the interstitial matrix elements use the gradient
         (weak) form, so the MT side must too; converting the volume
         Laplacian form (what the ODE images hf encode) to the gradient form
-        adds (1/4) R^2 (f1(R) f2'(R) + f1'(R) f2(R)) after symmetrization
-        (reference: the APW surface contribution in set_fv_h_o,
-        hamiltonian.hpp — the a^* b u u' boundary term)."""
+        adds (1/4) R^2 M^-1(R) (f1(R) f2'(R) + f1'(R) f2(R)) after
+        symmetrization (reference: the weak-form h_spherical_integrals of
+        atom_symmetry_class.cpp:616-640 carry 1/M inside the integral; the
+        boundary term of the ZORA kinetic operator -1/2 div(M^-1 grad)
+        carries the same factor)."""
         r2 = self.r**2
         vol = 0.5 * float(
             rint(f1.f * f2.hf * r2, self.r)
             + rint(f1.hf * f2.f * r2, self.r)
         )
         R = self.r[-1]
-        surf = 0.25 * R * R * (f1.fR * f2.fpR + f1.fpR * f2.fR)
+        surf = 0.25 * R * R * self.minv_R * (
+            f1.fR * f2.fpR + f1.fpR * f2.fR
+        )
         return vol + surf
 
 
@@ -155,8 +160,17 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
                 fpR=(ca * uapR + cb * ubpR) / nrm,
             )
         )
+    minv_R = 1.0
+    # ZORA/IORA only: their interstitial kinetic carries the matching
+    # theta/M correction (scf_fp kin_box); KH's mass is energy-dependent
+    # and the reference treats KH interstitials non-relativistically
+    if rel in ("zora", "iora"):
+        from sirius_tpu.lapw.radial_solver import SQ_ALPHA_HALF
+
+        minv_R = 1.0 / (1.0 - SQ_ALPHA_HALF * float(v_sph[-1]))
     return AtomRadialBasis(
-        lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l, lo_enu=lo_enu
+        lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l, lo_enu=lo_enu,
+        minv_R=minv_R,
     )
 
 

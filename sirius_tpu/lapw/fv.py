@@ -63,13 +63,18 @@ def _box_lookup(box, mill_diff, dims):
 
 def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
                 basis_by_atom, v_mt_lm_by_atom, theta_box, vtheta_box,
-                dims, omega):
+                dims, omega, kin_box=None, o2_box=None):
     """(H, O) complex Hermitian matrices over [APW(G) | lo] for one k.
 
     gk_millers: [nG, 3] integer G of the APW set; v_mt_lm_by_atom: per
     atom [lmmax_pot, nr] REAL-harmonic non-spherical potential (the
     spherical lm=0 component must be EXCLUDED — it lives in the radial
-    basis through hf)."""
+    basis through hf).
+
+    kin_box: convolution box for the kinetic term. Plain theta for
+    rel=none; FFT(theta/M) for ZORA/IORA (the interstitial mass correction,
+    reference set_fv_h_o_it + generate_pw_coefs). o2_box: IORA's overlap
+    correction box FFT(theta/M^2) scaled by alpha^2/2 at the caller."""
     # rows of recip are b_i (a_i . b_j = 2 pi delta_ij): gcart = m @ recip,
     # NOT m @ recip.T (equal only for symmetric lattice matrices)
     recip = 2.0 * np.pi * np.linalg.inv(lattice).T
@@ -91,9 +96,12 @@ def assemble_fv(gk_millers, k_frac, lattice, positions, rmt_by_atom,
     md = gk_millers[:, None, :] - gk_millers[None, :, :]
     th = _box_lookup(theta_box, md, dims)
     vth = _box_lookup(vtheta_box, md, dims)
+    kin = th if kin_box is None else _box_lookup(kin_box, md, dims)
     tfac = 0.5 * np.einsum("gi,hi->gh", gk_cart, gk_cart)
     O[:ng, :ng] = th
-    H[:ng, :ng] = tfac * th + vth
+    if o2_box is not None:  # IORA: O += (alpha^2/2) T (theta/M^2)
+        O[:ng, :ng] += tfac * _box_lookup(o2_box, md, dims)
+    H[:ng, :ng] = tfac * kin + vth
 
     from sirius_tpu.lapw.basis import matching_coefficients
 

@@ -65,11 +65,12 @@ def pseudo_density_g(rho_i_g, millers, gcart, omega, positions, rmt, dq_by_atom,
     out = rho_i_g.astype(np.complex128).copy()
     glen = np.linalg.norm(gcart, axis=1)
     if nw is None:
-        # reference pseudo_density_order_ = 9 (potential.hpp:79), clamped so
-        # the compensator's spectral peak (GR ~ l + n + 1) stays inside the
-        # represented G set on low-cutoff decks
-        gmax_r = float(glen.max()) * float(np.max(rmt))
-        nw = max(2, min(9, int(gmax_r / 2) - lmax))
+        # reference pseudo_density_order_ = 9 (potential.hpp:79) — FIXED,
+        # even when the compensator's spectral peak (GR ~ l + n + 1) pushes
+        # against the represented G set: the truncation systematics are part
+        # of the reference's numerical definition (clamping to lower order
+        # shifts the l=0 boundary potential by ~mHa; test12 graphite)
+        nw = 9
     nz = glen > 1e-12
     ghat = np.where(nz[:, None], gcart / np.maximum(glen, 1e-12)[:, None], 0.0)
     ghat[~nz] = [0, 0, 1]

@@ -476,6 +476,17 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         # ---- band problem per k: first variation (no B field) ----
         th_box = np.fft.fftn(ctx.theta_r) / n
         vth_box = np.fft.fftn(veff_r * ctx.theta_r) / n
+        # ZORA/IORA interstitial mass correction: the kinetic convolution
+        # uses theta/M with M = 1 - (alpha^2/2) V(r) (reference
+        # generate_pw_coefs + set_fv_h_o_it); IORA also corrects O
+        kin_box = o2_box = None
+        if rel_val in ("zora", "iora"):
+            from sirius_tpu.lapw.radial_solver import SQ_ALPHA_HALF
+
+            m_r = 1.0 - SQ_ALPHA_HALF * veff_r
+            kin_box = np.fft.fftn(ctx.theta_r / m_r) / n
+            if rel_val == "iora":
+                o2_box = SQ_ALPHA_HALF * np.fft.fftn(ctx.theta_r / m_r**2) / n
         evals_k, C_k = [], []
         for ik, k in enumerate(ctx.kpoints):
             H, O = assemble_fv(
@@ -483,6 +494,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 basis_by_atom,
                 [v[:lmmax_pot] for v in veff_mt],
                 th_box, vth_box, ctx.dims, ctx.omega,
+                kin_box=kin_box, o2_box=o2_box,
             )
             ev, C = diagonalize_fv(H, O, nev, e_floor=e_floor_fv)
             evals_k.append(ev)

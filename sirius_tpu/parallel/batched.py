@@ -53,7 +53,7 @@ class HkSetParams(NamedTuple):
     o_diag: jax.Array  # [nk, ngk] (S is spin-independent)
     hub_re: jax.Array = None  # [nk, nhub, ngk] S-weighted Hubbard orbitals
     hub_im: jax.Array = None
-    vhub_re: jax.Array = None  # [ns, nhub, nhub]
+    vhub_re: jax.Array = None  # [nk, ns, nhub, nhub] (per-k: +V phases)
     vhub_im: jax.Array = None
 
 
@@ -130,8 +130,8 @@ def hkset_slice_r(params: HkSetParams, ik: int = 0, ispn: int = 0):
         qmat=params.qmat,
         hub_re=None if params.hub_re is None else params.hub_re[ik],
         hub_im=None if params.hub_im is None else params.hub_im[ik],
-        vhub_re=None if params.vhub_re is None else params.vhub_re[ispn],
-        vhub_im=None if params.vhub_im is None else params.vhub_im[ispn],
+        vhub_re=None if params.vhub_re is None else params.vhub_re[ik, ispn],
+        vhub_im=None if params.vhub_im is None else params.vhub_im[ik, ispn],
     )
 
 
@@ -224,7 +224,8 @@ def initialize_subspace_kset(params: HkSetParams, psi_re, psi_im, nb: int):
     psi = _cplx(psi_re, psi_im)
     has_hub = params.hub_re is not None
 
-    def one_k(ekin, mask, fft_index, beta_re, beta_im, hub_re_k, hub_im_k, psi_k):
+    def one_k(ekin, mask, fft_index, beta_re, beta_im, hub_re_k, hub_im_k,
+              vhub_re_k, vhub_im_k, psi_k):
         def one_spin(veff_s, dion_s, vhub_re_s, vhub_im_s, x0):
             pk = HkParams(
                 veff_r=veff_s,
@@ -245,15 +246,16 @@ def initialize_subspace_kset(params: HkSetParams, psi_re, psi_im, nb: int):
             one_spin,
             in_axes=(0, 0, None if not has_hub else 0,
                      None if not has_hub else 0, 0),
-        )(params.veff_r, params.dion, params.vhub_re, params.vhub_im, psi_k)
+        )(params.veff_r, params.dion, vhub_re_k, vhub_im_k, psi_k)
 
     hub_ax = 0 if has_hub else None
     x = jax.vmap(
         one_k,
-        in_axes=(0, 0, 0, 0, 0, hub_ax, hub_ax, 0),
+        in_axes=(0, 0, 0, 0, 0, hub_ax, hub_ax, hub_ax, hub_ax, 0),
     )(
         params.ekin, params.mask, params.fft_index, params.beta_re,
-        params.beta_im, params.hub_re, params.hub_im, psi,
+        params.beta_im, params.hub_re, params.hub_im,
+        params.vhub_re, params.vhub_im, psi,
     )
     return jnp.real(x), jnp.imag(x)
 
@@ -270,7 +272,7 @@ def davidson_kset(
     has_hub = params.hub_re is not None
 
     def one_k(ekin, mask, fft_index, beta_re, beta_im, h_diag_k, o_diag,
-              hub_re_k, hub_im_k, psi_k):
+              hub_re_k, hub_im_k, vhub_re_k, vhub_im_k, psi_k):
         def one_spin(veff_s, dion_s, vhub_re_s, vhub_im_s, h_diag_s, x0):
             pk = HkParams(
                 veff_r=veff_s,
@@ -292,17 +294,17 @@ def davidson_kset(
             one_spin,
             in_axes=(0, 0, None if not has_hub else 0,
                      None if not has_hub else 0, 0, 0),
-        )(params.veff_r, params.dion, params.vhub_re, params.vhub_im,
+        )(params.veff_r, params.dion, vhub_re_k, vhub_im_k,
           h_diag_k, psi_k)
 
     hub_ax = 0 if has_hub else None
     ev, x, rn = jax.vmap(
         one_k,
-        in_axes=(0, 0, 0, 0, 0, 0, 0, hub_ax, hub_ax, 0),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, hub_ax, hub_ax, hub_ax, hub_ax, 0),
     )(
         params.ekin, params.mask, params.fft_index, params.beta_re,
         params.beta_im, params.h_diag, params.o_diag,
-        params.hub_re, params.hub_im, psi,
+        params.hub_re, params.hub_im, params.vhub_re, params.vhub_im, psi,
     )
     return ev, jnp.real(x), jnp.imag(x), rn
 

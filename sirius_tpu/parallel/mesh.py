@@ -47,3 +47,59 @@ def shard_kset(mesh: Mesh, psi):
 
 def kset_spec() -> P:
     return P("k", None, "b", None)
+
+
+def production_mesh(nk: int, nb: int):
+    """Mesh for the production SCF on however many devices are present.
+
+    Factors the device count as num_k x num_b with num_k = gcd(nk, ndev)
+    (k-parallelism first — embarrassingly parallel band solves); bands are
+    sharded only when nb divides evenly, otherwise replicated over "b".
+    Returns (mesh, psi_spec) or (None, None) on a single device — callers
+    keep the exact single-device code path in that case."""
+    import math
+
+    ndev = len(jax.devices())
+    if ndev <= 1:
+        return None, None
+    num_k = math.gcd(max(nk, 1), ndev)
+    num_b = ndev // num_k
+    band_ax = "b" if (num_b > 1 and nb % num_b == 0) else None
+    if num_k == 1 and band_ax is None:
+        # fully-replicated degenerate case (nk coprime with ndev and nb
+        # does not divide): no parallelism to gain, keep single-device path
+        return None, None
+    mesh = make_mesh(num_k=num_k, num_b=num_b)
+    return mesh, P("k", None, band_ax, None)
+
+
+def place_kset_params(params, mesh: Mesh):
+    """device_put every leaf of an HkSetParams with its natural sharding:
+    leading-nk leaves split over "k", spin/shared tables replicated. A
+    device_put onto an identical sharding is a no-op, so calling this per
+    SCF iteration only moves the refreshed potential-dependent leaves."""
+    if mesh is None:
+        return params
+    k1 = NamedSharding(mesh, P("k", None))
+    k2 = NamedSharding(mesh, P("k", None, None))
+    rep = NamedSharding(mesh, P())
+
+    def put(x, s):
+        return None if x is None else jax.device_put(x, s)
+
+    return params._replace(
+        veff_r=put(params.veff_r, rep),
+        ekin=put(params.ekin, k1),
+        mask=put(params.mask, k1),
+        fft_index=put(params.fft_index, k1),
+        beta_re=put(params.beta_re, k2),
+        beta_im=put(params.beta_im, k2),
+        dion=put(params.dion, rep),
+        qmat=put(params.qmat, rep),
+        h_diag=put(params.h_diag, k2),
+        o_diag=put(params.o_diag, k1),
+        hub_re=put(params.hub_re, k2),
+        hub_im=put(params.hub_im, k2),
+        vhub_re=put(params.vhub_re, rep),
+        vhub_im=put(params.vhub_im, rep),
+    )

@@ -293,3 +293,107 @@ class GroundStateStepper:
             **{k: e[k] for k in ("vha", "vxc", "exc", "bxc", "veff", "vloc")},
             "ewald": self.ctx.e_ewald,
         }
+
+    # --- real-space grid exchange (reference sirius_set/get_rg_values) --
+
+    def rg_dims(self) -> tuple:
+        return tuple(self.ctx.gvec.fft.dims)
+
+    def get_rg_values(self, label: str) -> np.ndarray:
+        """Field values on the FULL fine real-space box [n1, n2, n3]."""
+        from sirius_tpu.core.fftgrid import g_to_r
+        import jax.numpy as jnp
+
+        f_g = self.get_pw_coeffs(label)
+        box = g_to_r(
+            jnp.asarray(f_g), jnp.asarray(self.ctx.gvec.fft_index),
+            self.ctx.gvec.fft.dims,
+        )
+        return np.real(np.asarray(box))
+
+    def set_rg_values(self, label: str, values: np.ndarray) -> None:
+        from sirius_tpu.core.fftgrid import r_to_g
+        import jax.numpy as jnp
+
+        v = np.asarray(values, dtype=np.float64)
+        if v.shape != tuple(self.ctx.gvec.fft.dims):
+            raise ValueError(
+                f"expected box {self.ctx.gvec.fft.dims}, got {v.shape}"
+            )
+        f_g = np.asarray(
+            r_to_g(
+                jnp.asarray(v, dtype=jnp.complex128),
+                jnp.asarray(self.ctx.gvec.fft_index), self.ctx.gvec.fft.dims,
+            )
+        )
+        self.set_pw_coeffs(label, f_g)
+
+    # --- checkpointing (reference sirius_save_state/load_state) ---------
+
+    def save_state(self, path: str) -> None:
+        from sirius_tpu.io.checkpoint import save_state as _save
+
+        from sirius_tpu.parallel.batched import join_cplx
+
+        psi = None if self._pr is None else join_cplx(self._pr, self._pi)
+        _save(
+            path, self.ctx,
+            rho_g=self.rho_g, mag_g=self.mag_g,
+            psi=psi, band_energies=self.evals,
+            band_occupancies=self.occ, paw_dm=self.paw_dm,
+        )
+
+    def load_state(self, path: str) -> None:
+        from sirius_tpu.io.checkpoint import load_state as _load
+
+        st = _load(path, self.ctx)
+        self.rho_g = np.asarray(st["rho_g"])
+        if self.polarized and st.get("mag_g") is not None:
+            self.mag_g = np.asarray(st["mag_g"])
+        if st.get("psi") is not None:
+            from sirius_tpu.parallel.batched import split_cplx
+
+            pr, pi = split_cplx(np.asarray(st["psi"]), np.float64)
+            self._pr, self._pi = jnp.asarray(pr), jnp.asarray(pi)
+        if st.get("band_energies") is not None:
+            self.evals = np.asarray(st["band_energies"])
+        if st.get("band_occupancies") is not None:
+            self.occ = np.asarray(st["band_occupancies"])
+        if self.paw is not None and st.get("paw_dm") is not None:
+            self.paw_dm = np.asarray(st["paw_dm"])
+
+    # --- Sternheimer solve for a QE-driven DFPT loop (reference
+    # sirius_linear_solver, backed by solvers/multi_cg) ------------------
+
+    def linear_solver(self, vkq, psi, eigvals, dvpsi, alpha_pv: float = 0.0,
+                      spin: int = 1, tol: float = 1e-8) -> np.ndarray:
+        """Solve (H - eps_n S + alpha_pv P_occ) |dpsi_n> = -|dvpsi_n>.
+
+        psi/dvpsi: [ngk, n] column vectors at this k (the host's layout);
+        returns dpsi with the same shape. Single-k embedding: vkq must
+        match one of the context's k-points."""
+        from sirius_tpu.dft.linear_response import solve_sternheimer_k
+        from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
+
+        ctx = self.ctx
+        kpts = np.asarray(ctx.gkvec.kpoints)
+        ik = int(np.argmin(np.sum((kpts - np.asarray(vkq)) ** 2, axis=1)))
+        ispn = max(0, int(spin) - 1)
+        if self.pot is None:
+            self.generate_effective_potential()
+        d = self._d_by_spin()[ispn]
+        prm = make_hk_params(ctx, ik, self.pot.veff_r_coarse[ispn], d)
+        ngk_max = ctx.gkvec.ngk_max
+        # host arrays are [n, ngk_host]; pad/crop to the context's ngk_max
+        psi_rows = np.zeros((psi.shape[1], ngk_max), dtype=np.complex128)
+        dv_rows = np.zeros_like(psi_rows)
+        ncp = min(psi.shape[0], ngk_max)
+        psi_rows[:, :ncp] = np.asarray(psi).T[:, :ncp]
+        dv_rows[:, :ncp] = np.asarray(dvpsi).T[:, :ncp]
+        dpsi, _niter, _res = solve_sternheimer_k(
+            apply_h_s, prm, psi_rows, np.asarray(eigvals), dv_rows,
+            alpha_pv=alpha_pv, tol=tol,
+        )
+        out = np.zeros((psi.shape[0], psi.shape[1]), dtype=np.complex128)
+        out[:ncp, :] = np.asarray(dpsi).T[:ncp, :]
+        return out

@@ -1,0 +1,27 @@
+"""Direct minimization must reach the mixed-SCF ground-state energy.
+
+Validates the ensemble-DFT descent (dft/direct_min.py) against the
+recorded reference total for test23 (H atom NC LDA, the fastest PP deck)
+— the round-3 VERDICT "done" criterion: one deck converged via direct
+minimization matching its mixed-SCF energy."""
+
+import json
+import os
+
+from tests.conftest import REFERENCE_ROOT, requires_reference
+
+
+@requires_reference
+def test_direct_min_matches_scf_energy():
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.dft.direct_min import run_direct_min
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test23")
+    cfg = load_config(os.path.join(base, "sirius.json"))
+    res = run_direct_min(cfg, base_dir=base, max_steps=200)
+    ref = json.load(open(os.path.join(base, "output_ref.json")))["ground_state"]
+    de = abs(res["energy"]["total"] - ref["energy"]["total"])
+    assert res["converged"], "direct minimization did not converge"
+    # the descent reaches the SCF minimum; bar is looser than the SCF deck
+    # bar because the stopping criterion is a gradient norm, not a mixer rms
+    assert de < 5e-5, f"direct-min energy off by {de}"

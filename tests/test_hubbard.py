@@ -3,6 +3,7 @@
 import numpy as np
 
 from sirius_tpu.ops.hubbard import (
+    HubBlock,
     HubbardData,
     hubbard_potential_and_energy,
     rlm_rotation_matrix,
@@ -25,21 +26,21 @@ def test_potential_is_energy_derivative():
     """V must be dE/dn (variational consistency of the Dudarev form)."""
     hub = HubbardData(
         phi_s_gk=np.zeros((1, 5, 1), dtype=complex),
-        blocks=[(0, 0, 5, 0.3, 0.05, 2)],
+        blocks=[HubBlock(ia=0, off=0, nm=5, l=2, n=3, U=0.3, alpha=0.05)],
         num_hub_total=5,
     )
     rng = np.random.default_rng(0)
     m = rng.standard_normal((5, 5))
     nb = (m + m.T) / 8 + np.eye(5) * 0.5  # symmetric real
     n = np.stack([nb, nb * 0.8]).astype(complex)  # 2 spin channels
-    v, e0, _ = hubbard_potential_and_energy(hub, n)
+    v, _, e0, _ = hubbard_potential_and_energy(hub, n)
     h = 1e-6
     for (i, j) in [(0, 0), (1, 3), (2, 4)]:
         dn = np.zeros_like(n)
         dn[0, i, j] += h
         dn[0, j, i] += h  # keep symmetric
-        ep = hubbard_potential_and_energy(hub, n + dn)[1]
-        em = hubbard_potential_and_energy(hub, n - dn)[1]
+        ep = hubbard_potential_and_energy(hub, n + dn)[2]
+        em = hubbard_potential_and_energy(hub, n - dn)[2]
         fd = (ep - em) / (2 * h)
         an = float(np.real(v[0, i, j] + v[0, j, i]))
         np.testing.assert_allclose(an, fd, atol=1e-6)
@@ -49,14 +50,14 @@ def test_energy_values():
     # single fully occupied orbital (n=1): E = U/2 * (1 - 1) = 0
     hub = HubbardData(
         phi_s_gk=np.zeros((1, 1, 1), dtype=complex),
-        blocks=[(0, 0, 1, 0.5, 0.0, 0)],
+        blocks=[HubBlock(ia=0, off=0, nm=1, l=0, n=1, U=0.5)],
         num_hub_total=1,
     )
     # single-channel (unpolarized) matrices carry the x2 spin factor
     n = np.array([[[1.0 + 0j]]])
-    v, e, e1 = hubbard_potential_and_energy(hub, n)
+    v, _, e, e1 = hubbard_potential_and_energy(hub, n)
     np.testing.assert_allclose(e, 0.0, atol=1e-14)
     # half filling n=1/2: E = 2 * U/2 (1/2 - 1/4) = U/4
     n = np.array([[[0.5 + 0j]]])
-    _, e, _ = hubbard_potential_and_energy(hub, n)
+    _, _, e, _ = hubbard_potential_and_energy(hub, n)
     np.testing.assert_allclose(e, 0.5 / 4, atol=1e-14)

@@ -1,0 +1,60 @@
+"""UPF v2 -> JSON converter: element-wise parity with the pre-converted
+species JSONs shipped in verification/test32 (NC, US and PAW files)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_ROOT, requires_reference
+
+FILES = [
+    "O_pd_nc_sr_pbe_standard_0.4.1.upf",
+    "V.pbe-spnl-rrkjus_psl.1.0.0.UPF",
+    "Sr.pbe-spn-kjpaw_psl.1.0.0.UPF",
+]
+
+
+@requires_reference
+@pytest.mark.parametrize("fname", FILES)
+def test_upf2_converter_matches_shipped_json(fname):
+    from sirius_tpu.io.upf import upf2_to_json
+
+    base = os.path.join(REFERENCE_ROOT, "verification", "test32")
+    mine = upf2_to_json(os.path.join(base, fname))["pseudo_potential"]
+    ref = json.load(open(os.path.join(base, fname + ".json")))["pseudo_potential"]
+
+    assert set(mine) == set(ref)
+    for k in ref["header"]:
+        rv, mv = ref["header"][k], mine["header"].get(k)
+        if isinstance(rv, float):
+            assert abs(mv - rv) <= 1e-9 * max(1.0, abs(rv)), (k, mv, rv)
+        else:
+            assert mv == rv, (k, mv, rv)
+    for k in ("radial_grid", "local_potential", "core_charge_density",
+              "total_charge_density", "D_ion"):
+        if k in ref:
+            np.testing.assert_allclose(mine[k], ref[k], rtol=0, atol=0)
+    for k in ("beta_projectors", "atomic_wave_functions", "augmentation"):
+        if k not in ref:
+            continue
+        assert len(mine[k]) == len(ref[k])
+        for a, b in zip(mine[k], ref[k]):
+            np.testing.assert_allclose(
+                a["radial_function"], b["radial_function"], rtol=0, atol=0
+            )
+            for kk in b:
+                if kk != "radial_function":
+                    assert a[kk] == b[kk], (k, kk)
+    if "paw_data" in ref:
+        for kk, rv in ref["paw_data"].items():
+            mv = mine["paw_data"][kk]
+            if isinstance(rv, list) and rv and isinstance(rv[0], dict):
+                for a, b in zip(mv, rv):
+                    np.testing.assert_allclose(
+                        a["radial_function"], b["radial_function"],
+                        rtol=0, atol=0,
+                    )
+            else:
+                np.testing.assert_allclose(mv, rv, rtol=0, atol=0)
