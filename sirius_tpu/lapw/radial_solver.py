@@ -59,9 +59,238 @@ def _mass(rel: str, E: float, v):
     raise ValueError(rel)
 
 
+
+def _indicial_start(r, v2, l: int, rel: str):
+    """Series start values (p0, q0) at r[0] — shared by the numpy and jax
+    integrators (relativistic r^b for the scalar-relativistic cases at a
+    nuclear-singular potential, r^{l+1} otherwise)."""
+    zn_eff = max(-v2[0] * r[0], 0.0)
+    if rel in ("koelling_harmon", "zora", "iora") and zn_eff > 1e-8:
+        a0 = l * (l + 1) + 1.0 - (ALPHA * zn_eff) ** 2
+        b0 = 0.5 * (1.0 + np.sqrt(1.0 + 4.0 * a0))
+        p0 = r[0] ** b0
+        q0 = p0 * (b0 - 1.0) / (zn_eff * ALPHA * ALPHA)
+    else:
+        p0 = r[0] ** (l + 1)
+        q0 = 0.5 * l * r[0] ** l
+    return float(p0), float(q0)
+
+
+def _tri_samples(r, v2):
+    """Per-interval (start, mid, end) sample index map and arrays."""
+    n = len(r)
+    r2 = np.empty(2 * n - 1)
+    r2[0::2] = r
+    r2[1::2] = 0.5 * (r[:-1] + r[1:])
+    idx = np.arange(n - 1)
+    tri = np.stack([2 * idx, 2 * idx + 1, 2 * idx + 2], axis=1)
+    return r2, tri
+
+
+def _jax_mass(rel: str):
+    """jnp mass function of (E, v) for a relativity flavor (the jnp twin
+    of _mass; kept in one place so the variants cannot desynchronize)."""
+    import jax.numpy as jnp
+
+    def mass(E, v):
+        if rel == "none":
+            return jnp.ones_like(v)
+        if rel == "koelling_harmon":
+            return 1.0 + SQ_ALPHA_HALF * (E - v)
+        if rel == "zora":
+            return 1.0 - SQ_ALPHA_HALF * v
+        m0 = 1.0 - SQ_ALPHA_HALF * v
+        return m0 / (1.0 - SQ_ALPHA_HALF * E / m0)
+
+    return mass
+
+
+_SCAN_CACHE: dict = {}
+
+
+def _jax_rk4(n: int, rel: str, has_src: bool):
+    """Jitted lax.scan RK4 outward integrator for an n-point grid.
+
+    Same arithmetic as the numpy loop below (same coefficient samples at
+    nodes and interval midpoints, same 1e60 renormalization, same
+    node-count semantics), compiled once per (n, rel, has_src) — the
+    radial solver is the LAPW hot spot (60 of 128 s/iteration in the
+    test12 profile came from the python RK4 loop)."""
+    key = (n, rel, has_src)
+    fn = _SCAN_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    mass = _jax_mass(rel)
+
+    def run(E, hsteps, r3, v3, sp3, sq3, ll, p0, q0, ncut):
+        """hsteps: [n-1]; r3/v3/(sp3/sq3): [n-1, 3] start/mid/end samples.
+        ncut: steps beyond ncut are frozen (h=0 equivalent)."""
+        m3 = mass(E, v3)
+        a_pq = 2.0 * m3
+        a_qp = v3 - E + ll / (m3 * r3 * r3)
+        inv_r = 1.0 / r3
+
+        def f(j, pp, qq, x):
+            dp = x["a_pq"][j] * qq + pp * x["inv_r"][j]
+            dq = x["a_qp"][j] * pp - qq * x["inv_r"][j]
+            if has_src:
+                dp = dp + x["sp"][j]
+                dq = dq + x["sq"][j]
+            return dp, dq
+
+        def step(carry, x):
+            yp, yq, nodes, ls = carry
+            h = x["h"]
+            k1p, k1q = f(0, yp, yq, x)
+            k2p, k2q = f(1, yp + 0.5 * h * k1p, yq + 0.5 * h * k1q, x)
+            k3p, k3q = f(1, yp + 0.5 * h * k2p, yq + 0.5 * h * k2q, x)
+            k4p, k4q = f(2, yp + h * k3p, yq + h * k3q, x)
+            ypn = yp + (h / 6.0) * (k1p + 2 * k2p + 2 * k3p + k4p)
+            yqn = yq + (h / 6.0) * (k1q + 2 * k2q + 2 * k3q + k4q)
+            live = x["live"]
+            ypn = jnp.where(live, ypn, yp)
+            yqn = jnp.where(live, yqn, yq)
+            s = jnp.maximum(jnp.abs(ypn), jnp.abs(yqn))
+            do_scale = live & (s > 1e60)
+            scale = jnp.where(do_scale, s, 1.0)
+            ypn = ypn / scale
+            yqn = yqn / scale
+            ls = ls + jnp.log(scale)
+            nodes = nodes + jnp.where(live & (ypn * yp < 0), 1, 0)
+            return (ypn, yqn, nodes, ls), (ypn, yqn, ls)
+
+        # scan xs leaves carry leading axis n-1; the per-step slice of a
+        # [n-1, 3] coefficient array is [3], indexed by j inside f
+        live = jnp.arange(n - 1) < ncut
+        xs = {
+            "h": hsteps, "a_pq": a_pq, "a_qp": a_qp, "inv_r": inv_r,
+            "live": live,
+        }
+        if has_src:
+            xs["sp"] = sp3
+            xs["sq"] = sq3
+        (ypf, yqf, nodes, lsf), (ps, qs, lss) = jax.lax.scan(
+            step, (p0, q0, 0, 0.0), xs
+        )
+        return ps, qs, lss, nodes, lsf
+
+    fn = jax.jit(run)
+    _SCAN_CACHE[key] = fn
+    return fn
+
+
+def _use_jax_solver() -> bool:
+    import os
+
+    return os.environ.get("SIRIUS_TPU_NUMPY_RADIAL", "") != "1"
+
+
+_BATCH_CACHE: dict = {}
+
+
+def _jax_rk4_nodes(n: int, rel: str):
+    """Carry-only vmapped variant of _jax_rk4: for an energy VECTOR,
+    returns (nodes [m], p(R) [m], q(R) [m]) in the final renormalization
+    frame — the primitive behind the K-section bound-state and Enu
+    searches (no per-point storage, so the scan is light)."""
+    key = (n, rel)
+    fn = _BATCH_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    mass = _jax_mass(rel)
+
+    def run_one(E, hsteps, r3, v3, ll, p0, q0):
+        m3 = mass(E, v3)
+        a_pq = 2.0 * m3
+        a_qp = v3 - E + ll / (m3 * r3 * r3)
+        inv_r = 1.0 / r3
+
+        def f(j, pp, qq, x):
+            return (
+                x["a_pq"][j] * qq + pp * x["inv_r"][j],
+                x["a_qp"][j] * pp - qq * x["inv_r"][j],
+            )
+
+        def step(carry, x):
+            yp, yq, nodes, ls = carry
+            h = x["h"]
+            k1p, k1q = f(0, yp, yq, x)
+            k2p, k2q = f(1, yp + 0.5 * h * k1p, yq + 0.5 * h * k1q, x)
+            k3p, k3q = f(1, yp + 0.5 * h * k2p, yq + 0.5 * h * k2q, x)
+            k4p, k4q = f(2, yp + h * k3p, yq + h * k3q, x)
+            ypn = yp + (h / 6.0) * (k1p + 2 * k2p + 2 * k3p + k4p)
+            yqn = yq + (h / 6.0) * (k1q + 2 * k2q + 2 * k3q + k4q)
+            s = jnp.maximum(jnp.abs(ypn), jnp.abs(yqn))
+            scale = jnp.where(s > 1e60, s, 1.0)
+            ypn = ypn / scale
+            yqn = yqn / scale
+            ls = ls + jnp.log(scale)
+            nodes = nodes + jnp.where(ypn * yp < 0, 1, 0)
+            return (ypn, yqn, nodes, ls), None
+
+        xs = {"h": hsteps, "a_pq": a_pq, "a_qp": a_qp, "inv_r": inv_r}
+        (ypf, yqf, nodes, lsf), _ = jax.lax.scan(step, (p0, q0, 0, 0.0), xs)
+        return nodes, ypf, yqf, lsf
+
+    fn = jax.jit(
+        jax.vmap(run_one, in_axes=(0, None, None, None, None, None, None))
+    )
+    _BATCH_CACHE[key] = fn
+    return fn
+
+
+class _BatchEval:
+    """Batched (vmapped-over-E) evaluator for one (grid, potential, l):
+    nodes/boundary values for an energy vector in one compiled call."""
+
+    def __init__(self, r, veff, l: int, rel: str, v2=None):
+        import jax.numpy as jnp
+
+        n = len(r)
+        if v2 is None:
+            v2 = _with_midpoints(r, veff)
+        r2, tri = _tri_samples(r, v2)
+        p0, q0 = _indicial_start(r, v2, l, rel)
+        self._fn = _jax_rk4_nodes(n, rel)
+        self._args = (
+            jnp.asarray(np.diff(r)), jnp.asarray(r2[tri]),
+            jnp.asarray(v2[tri]), float(0.5 * l * (l + 1)),
+            float(p0), float(q0),
+        )
+        self._rel = rel
+        self._vR = float(veff[-1])
+        self._R = float(r[-1])
+
+    def __call__(self, evec):
+        import jax.numpy as jnp
+
+        nodes, pR, qR, lsf = self._fn(jnp.asarray(np.atleast_1d(evec)), *self._args)
+        return (
+            np.asarray(nodes), np.asarray(pR), np.asarray(qR),
+            np.asarray(lsf),
+        )
+
+    def pderiv(self, evec):
+        """p'(R) = 2 M(R) q(R) + p(R)/R per energy, in the final
+        renormalization frame — identical to the numpy path's use of the
+        stored (renormalized) p, q arrays in find_enu_band."""
+        nodes, pR, qR, lsf = self(evec)
+        m = np.array([
+            float(_mass(self._rel, float(e), np.asarray([self._vR]))[0])
+            for e in np.atleast_1d(evec)
+        ])
+        return 2.0 * m * qR + pR / self._R, nodes
+
+
 def integrate_outward(r, veff, l: int, E: float, rel: str = "none",
                       p_prev=None, q_prev=None, mderiv: int = 0,
-                      v2=None):
+                      v2=None, ncut: int | None = None):
     """RK4 outward integration. Returns (p, q, num_nodes).
 
     p_prev/q_prev: (2n-1)-sampled previous-order arrays for mderiv=1 (use
@@ -72,6 +301,48 @@ def integrate_outward(r, veff, l: int, E: float, rel: str = "none",
     n = len(r)
     if v2 is None:
         v2 = _with_midpoints(r, veff)
+    if _use_jax_solver():
+        import jax.numpy as jnp
+
+        has_src = mderiv >= 1
+        kh = rel in ("koelling_harmon", "iora")
+        ll2 = 0.5 * l * (l + 1)
+        r2, tri = _tri_samples(r, v2)
+        sp3 = sq3 = np.zeros((n - 1, 3))
+        if has_src:
+            m2 = _mass(rel, E, v2)
+            if kh:
+                srcp = mderiv * ALPHA * ALPHA * q_prev
+                srcq = -mderiv * (
+                    1.0 + ll2 * ALPHA * ALPHA / (2.0 * m2 * m2 * r2 * r2)
+                ) * p_prev
+            else:
+                srcp = np.zeros_like(v2)
+                srcq = -mderiv * p_prev
+            sp3 = srcp[tri]
+            sq3 = srcq[tri]
+        p0, q0 = _indicial_start(r, v2, l, rel)
+        fn = _jax_rk4(n, rel, has_src)
+        ps, qs, lss, nodes, lsf = fn(
+            float(E), jnp.asarray(np.diff(r)), jnp.asarray(r2[tri]),
+            jnp.asarray(v2[tri]), jnp.asarray(sp3), jnp.asarray(sq3),
+            float(ll2), float(p0), float(q0),
+            int(n - 1 if ncut is None else min(ncut, n) - 1),
+        )
+        p = np.empty(n)
+        q = np.empty(n)
+        p[0], q[0] = p0, q0
+        ls = np.asarray(lss)
+        lsf = float(lsf)
+        # reconstruct the final renormalization frame: stored values carry
+        # the cumulative scale at their own step; bring the prefix into the
+        # final frame (exp of a NEGATIVE number — never overflows)
+        fac = np.exp(ls - lsf)
+        p[1:] = np.asarray(ps) * fac
+        q[1:] = np.asarray(qs) * fac
+        p[0] *= np.exp(-lsf)
+        q[0] *= np.exp(-lsf)
+        return p, q, int(nodes)
     r2 = np.empty(2 * n - 1)
     r2[0::2] = r
     r2[1::2] = 0.5 * (r[:-1] + r[1:])
@@ -156,20 +427,40 @@ def find_bound_state(r, veff, l: int, n: int, rel: str = "none",
     assert target_nodes >= 0
     v2 = _with_midpoints(r, veff)
     lo, hi = e_lo, e_hi
-    for _ in range(max_iter):
-        mid = 0.5 * (lo + hi)
-        _, _, nd = integrate_outward(r, veff, l, mid, rel, v2=v2)
-        if nd > target_nodes:
-            hi = mid
-        else:
-            lo = mid
-        if hi - lo < tol * max(1.0, abs(lo)):
-            break
+    if _use_jax_solver():
+        # K-section search: one vmapped call shrinks the bracket K-1 fold
+        # (the node count is monotonic in E)
+        be = _BatchEval(r, veff, l, rel, v2=v2)
+        K = 17
+        for _ in range(max_iter):
+            es = np.linspace(lo, hi, K)
+            nd = be(es)[0]
+            above = np.nonzero(nd > target_nodes)[0]
+            j = int(above[0]) if len(above) else K - 1
+            lo, hi = es[max(j - 1, 0)], es[j]
+            if hi - lo < tol * max(1.0, abs(lo)):
+                break
+    else:
+        for _ in range(max_iter):
+            mid = 0.5 * (lo + hi)
+            _, _, nd = integrate_outward(r, veff, l, mid, rel, v2=v2)
+            if nd > target_nodes:
+                hi = mid
+            else:
+                lo = mid
+            if hi - lo < tol * max(1.0, abs(lo)):
+                break
     E = 0.5 * (lo + hi)
     ncut = _decay_cutoff_index(r, veff, l, E)
-    p_c, _, _ = integrate_outward(r[:ncut], veff[:ncut], l, E, rel)
-    p = np.zeros(len(r))
-    p[:ncut] = p_c
+    if _use_jax_solver():
+        # fixed-shape solve with frozen tail (one compilation per grid
+        # length instead of one per truncation point)
+        p, _, _ = integrate_outward(r, veff, l, E, rel, v2=v2, ncut=ncut)
+        p[ncut:] = 0.0
+    else:
+        p_c, _, _ = integrate_outward(r[:ncut], veff[:ncut], l, E, rel)
+        p = np.zeros(len(r))
+        p[:ncut] = p_c
     p = _cut_forbidden_tail(p, r, veff, l, E)
     u = p / r
     nrm = np.sqrt(rint(p * p, r))
@@ -227,39 +518,122 @@ def find_enu_band(r, veff, l: int, n: int, rel: str = "none"):
     v2 = _with_midpoints(r, veff)
     R = r[-1]
 
-    def pderiv(E):
-        p, q, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
-        m = float(_mass(rel, E, np.asarray([veff[-1]]))[0])
-        return 2.0 * m * q[-1] + p[-1] / R
+    if _use_jax_solver():
+        be = _BatchEval(r, veff, l, rel, v2=v2)
+
+        def pderiv(E):
+            return float(be.pderiv([E])[0][0])
+
+        def pderiv_batch(es):
+            return be.pderiv(es)[0]
+    else:
+        def pderiv(E):
+            p, q, _ = integrate_outward(r, veff, l, E, rel, v2=v2)
+            m = float(_mass(rel, E, np.asarray([veff[-1]]))[0])
+            return 2.0 * m * q[-1] + p[-1] / R
+
+        def pderiv_batch(es):
+            return np.array([pderiv(float(e)) for e in es])
 
     sd = pderiv(etop)
-    denu = 1e-8
-    e0 = etop
-    bracketed = False
-    for _ in range(60):
-        if pderiv(e0) * sd <= 0:
-            bracketed = True
-            break
-        if denu > 20:
-            break
-        denu *= 2
-        e0 -= denu
-    if not bracketed:
+    # expansion: the same doubling ladder as the scalar path, but evaluated
+    # as one batch (e0_k = etop - (2^{k+1} - 2) * 1e-8)
+    denus = 1e-8 * 2.0 ** np.arange(1, 62)
+    denus = denus[denus <= 20 * 2]
+    offsets = np.concatenate([[0.0], np.cumsum(denus)])
+    ladder = etop - offsets
+    dv = pderiv_batch(ladder)
+    cross = np.nonzero(dv * sd <= 0)[0]
+    if not len(cross):
         # no p'(R) sign change within ~40 Ha below the band top: the band
         # has no well-defined bottom here — fall back to the top
         return etop, etop, etop
-    e1, e2 = e0, e0 + denu
-    for _ in range(80):
-        mid = 0.5 * (e1 + e2)
-        d = pderiv(mid)
-        if d * sd > 0:
-            e2 = mid
+    j = int(cross[0])
+    e1, e2 = ladder[j], ladder[max(j - 1, 0)]
+    for _ in range(14):
+        es = np.linspace(e1, e2, 9)
+        dvs = pderiv_batch(es)
+        # first index (from the top, e2 side) still on sd's side
+        same = dvs * sd > 0
+        # es ascending: e1..e2; the crossing lies between the last
+        # non-same and the first same index going up
+        idx_same = np.nonzero(same)[0]
+        if len(idx_same):
+            j2 = int(idx_same[0])
+            e1, e2 = es[max(j2 - 1, 0)], es[j2]
         else:
-            e1 = mid
-        if abs(d) < 1e-8 or (e2 - e1) < 1e-12:
+            e1, e2 = es[-2], es[-1]
+        if np.abs(dvs).min() < 1e-8 or (e2 - e1) < 1e-12:
             break
     ebot = 0.5 * (e1 + e2)
     return 0.5 * (ebot + etop), ebot, etop
+
+
+_DIRAC_CACHE: dict = {}
+
+
+def _jax_dirac(n: int, store: bool):
+    """Jitted (vmapped over E when store=False) Dirac RK4 integrator —
+    same arithmetic as the numpy loop in find_bound_state_dirac."""
+    key = (n, store)
+    fn = _DIRAC_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    two_c2 = 2.0 / (ALPHA * ALPHA)
+
+    def run_one(E, kappa, hsteps, r3, v3, p0, q0, ncut):
+        aPQ = ALPHA * (E - v3 + two_c2)
+        aQP = -ALPHA * (E - v3)
+        inv_r = 1.0 / r3
+
+        def f(j, pp, qq, x):
+            return (
+                -kappa * x["inv_r"][j] * pp + x["aPQ"][j] * qq,
+                kappa * x["inv_r"][j] * qq + x["aQP"][j] * pp,
+            )
+
+        def step(carry, x):
+            yp, yq, nodes, ls = carry
+            h = x["h"]
+            k1p, k1q = f(0, yp, yq, x)
+            k2p, k2q = f(1, yp + 0.5 * h * k1p, yq + 0.5 * h * k1q, x)
+            k3p, k3q = f(1, yp + 0.5 * h * k2p, yq + 0.5 * h * k2q, x)
+            k4p, k4q = f(2, yp + h * k3p, yq + h * k3q, x)
+            ypn = yp + (h / 6.0) * (k1p + 2 * k2p + 2 * k3p + k4p)
+            yqn = yq + (h / 6.0) * (k1q + 2 * k2q + 2 * k3q + k4q)
+            live = x["live"]
+            ypn = jnp.where(live, ypn, yp)
+            yqn = jnp.where(live, yqn, yq)
+            s = jnp.maximum(jnp.abs(ypn), jnp.abs(yqn))
+            do_scale = live & (s > 1e60)
+            scale = jnp.where(do_scale, s, 1.0)
+            ypn = ypn / scale
+            yqn = yqn / scale
+            ls = ls + jnp.log(scale)
+            nodes = nodes + jnp.where(live & (ypn * yp < 0), 1, 0)
+            return (ypn, yqn, nodes, ls), (
+                (ypn, yqn, ls) if store else None
+            )
+
+        live = jnp.arange(n - 1) < ncut
+        xs = {"h": hsteps, "aPQ": aPQ, "aQP": aQP, "inv_r": inv_r,
+              "live": live}
+        carry, ys = jax.lax.scan(step, (p0, q0, 0, 0.0), xs)
+        if store:
+            return ys[0], ys[1], ys[2], carry[2], carry[3]
+        return carry[2]
+
+    if store:
+        fn = jax.jit(run_one)
+    else:
+        fn = jax.jit(
+            jax.vmap(run_one, in_axes=(0,) + (None,) * 7)
+        )
+    _DIRAC_CACHE[key] = fn
+    return fn
 
 
 def find_bound_state_dirac(r, veff, n: int, kappa: int,
@@ -324,16 +698,53 @@ def find_bound_state_dirac(r, veff, n: int, kappa: int,
         return P, Q, nodes
 
     lo, hi = e_lo, e_hi
-    for _ in range(max_iter):
-        mid = 0.5 * (lo + hi)
-        if integrate(mid)[2] > target_nodes:
-            hi = mid
-        else:
-            lo = mid
-        if hi - lo < tol * max(1.0, abs(lo)):
-            break
-    E = 0.5 * (lo + hi)
-    P, Q, _ = integrate(E, nstop=_decay_cutoff_index(r, veff, l, E))
+    if _use_jax_solver():
+        import jax.numpy as jnp
+
+        _r2d, tri = _tri_samples(r, v2)
+        hsteps = jnp.asarray(np.diff(r))
+        r3 = jnp.asarray(r2[tri])
+        v3 = jnp.asarray(v2[tri])
+        P0 = float(r[0] ** gamma)
+        Q0 = float(P0 * (gamma + kappa) / (zeff * ALPHA))
+        nodes_fn = _jax_dirac(nmax, store=False)
+        K = 17
+        for _ in range(max_iter):
+            es = np.linspace(lo, hi, K)
+            nd = np.asarray(nodes_fn(
+                jnp.asarray(es), float(kappa), hsteps, r3, v3, P0, Q0,
+                nmax - 1,
+            ))
+            above = np.nonzero(nd > target_nodes)[0]
+            j = int(above[0]) if len(above) else K - 1
+            lo, hi = es[max(j - 1, 0)], es[j]
+            if hi - lo < tol * max(1.0, abs(lo)):
+                break
+        E = 0.5 * (lo + hi)
+        ncut = _decay_cutoff_index(r, veff, l, E)
+        ps, qs, lss, _, lsf = _jax_dirac(nmax, store=True)(
+            float(E), float(kappa), hsteps, r3, v3, P0, Q0, ncut - 1
+        )
+        P = np.empty(nmax)
+        Q = np.empty(nmax)
+        fac = np.exp(np.asarray(lss) - float(lsf))
+        P[0] = P0 * np.exp(-float(lsf))
+        Q[0] = Q0 * np.exp(-float(lsf))
+        P[1:] = np.asarray(ps) * fac
+        Q[1:] = np.asarray(qs) * fac
+        P[ncut:] = 0.0
+        Q[ncut:] = 0.0
+    else:
+        for _ in range(max_iter):
+            mid = 0.5 * (lo + hi)
+            if integrate(mid)[2] > target_nodes:
+                hi = mid
+            else:
+                lo = mid
+            if hi - lo < tol * max(1.0, abs(lo)):
+                break
+        E = 0.5 * (lo + hi)
+        P, Q, _ = integrate(E, nstop=_decay_cutoff_index(r, veff, l, E))
     P, Q = _cut_forbidden_tail(P, r, veff, l, E, q=Q)
     nrm = np.sqrt(rint(P * P + Q * Q, r))
     return E, (P / nrm) / r, (Q / nrm) / r

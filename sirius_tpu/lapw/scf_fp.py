@@ -266,7 +266,12 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
     r_ext = np.asarray(ext)
     r = np.concatenate([r_mt, r_ext])
     svmt = v_sph + sp.zn / r_mt  # electronic part (nucleus removed)
-    dsv = (svmt[-1] - svmt[-3]) / (r_mt[-1] - r_mt[-3])
+    # boundary slope via the cubic spline (reference svmt.deriv(1, nmtp-1),
+    # atom_symmetry_class.cpp:799) — a finite difference here shifts the
+    # alpha/r tail and with it the semicore eigenvalues at the mHa scale
+    from sirius_tpu.core.radial import Spline
+
+    dsv = float(Spline(r_mt, svmt).derivative(r_mt[-1]))
     alpha = -(R * R * dsv + sp.zn)
     beta = svmt[-1] - (sp.zn + alpha) / R
     v = np.concatenate([v_sph, alpha / r_ext + beta])

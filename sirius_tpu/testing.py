@@ -58,8 +58,12 @@ def synthetic_silicon_context(
     positions: np.ndarray | None = None,
     extra_params: dict | None = None,
     moments: np.ndarray | None = None,
+    supercell: int = 1,
 ) -> SimulationContext:
-    """Diamond-Si-like 2-atom cell with the synthetic species."""
+    """Diamond-Si-like 2-atom cell with the synthetic species.
+
+    supercell=n replicates the cell n x n x n (2 n^3 atoms) — the
+    Si-supercell-class bench tier (BASELINE.md flagship regime)."""
     import sirius_tpu.crystal.unit_cell as ucm
 
     params = {
@@ -79,12 +83,29 @@ def synthetic_silicon_context(
     t = synthetic_silicon_type(ultrasoft=ultrasoft)
     if positions is None:
         positions = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]])
+    positions = np.asarray(positions, dtype=np.float64)
+    if supercell > 1 and moments is not None:
+        raise ValueError("supercell>1 with explicit moments: tile them "
+                         "yourself (per-atom moments must cover all images)")
+    if supercell > 1:
+        n = supercell
+        shifts = np.array(
+            [[i, j, k] for i in range(n) for j in range(n) for k in range(n)],
+            dtype=np.float64,
+        )
+        positions = (
+            (positions[None, :, :] + shifts[:, None, :]) / n
+        ).reshape(-1, 3)
+        lattice = lattice * n
     uc = ucm.UnitCell(
         lattice=lattice,
         atom_types=[t],
-        type_of_atom=np.array([0, 0], dtype=np.int32),
-        positions=np.asarray(positions, dtype=np.float64),
-        moments=np.zeros((2, 3)) if moments is None else np.asarray(moments, float),
+        type_of_atom=np.zeros(len(positions), dtype=np.int32),
+        positions=positions,
+        moments=(
+            np.zeros((len(positions), 3))
+            if moments is None else np.asarray(moments, float)
+        ),
     )
     # SimulationContext.create reads species from files; build the parts
     # directly instead (same code path below the unit-cell level).

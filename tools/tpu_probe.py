@@ -30,7 +30,7 @@ def record(tier: str) -> int:
     subprocess and persist its timing for bench.py's recorded fallback."""
     import subprocess
 
-    tmo = {"full": 900, "micro": 300, "hpsi": 600}.get(tier, 600)
+    tmo = {"full": 900, "micro": 300, "hpsi": 600, "large": 1500}.get(tier, 600)
     r = None
     try:
         r = subprocess.run(
