@@ -149,16 +149,6 @@ class StressCalculator:
         return rho, mag
 
     # --- frozen-coefficient energy functionals -------------------------
-    def e_kinetic(self, eps, psi, occ_w):
-        gk = self._gkcart(eps)
-        e = 0.0
-        for ik in range(self.ctx.gkvec.num_kpoints):
-            ek = 0.5 * np.sum(gk[ik] ** 2, axis=-1)
-            for ispn in range(psi.shape[1]):
-                dens = np.einsum("b,bg->g", occ_w[ik, ispn], np.abs(np.asarray(psi[ik, ispn])) ** 2)
-                e += float(dens @ ek)
-        return e
-
     def e_hartree(self, eps):
         rho, _ = self._density_eps(eps)
         g2 = np.sum(self._gcart(eps) ** 2, axis=1)[1:]
@@ -329,14 +319,13 @@ class StressCalculator:
                 self._mag_aug0 = self._rho_aug_eps(np.zeros((3, 3)), self._dm_mag)
         occ_w = occ * ctx.gkvec.weights[:, None, None]
         terms = {
-            "kin": lambda e: self.e_kinetic(e, psi, occ_w),
             "har": lambda e: self.e_hartree(e),
             "vloc": lambda e: self.e_vloc(e),
             "ewald": lambda e: self.e_ewald(e),
             "xc": lambda e: self.e_xc(e),
             "nonloc": lambda e: self.e_nonloc(e, psi, occ_w, evals, d_by_spin),
         }
-        out = {}
+        out = {"kin": self.sigma_kinetic(psi, occ_w)}
         om = ctx.unit_cell.omega
         h = self.h
         for name, fn in terms.items():
@@ -353,6 +342,28 @@ class StressCalculator:
         total = sum(out.values())
         out["total"] = symmetrize_stress(ctx, total)
         return out
+
+    def sigma_kinetic(self, psi, occ_w) -> np.ndarray:
+        """CLOSED-FORM kinetic stress (reference stress.cpp sigma_kin):
+        under r -> (1+eps) r at frozen coefficients, gk -> (1+eps)^{-T} gk,
+        so d(1/2 |gk|^2)/d eps_ab = -gk_a gk_b and
+
+          sigma_kin_ab = -(1/Omega) sum_{k,s,b,G} w f |psi(G)|^2 gk_a gk_b
+
+        — exact, replacing 12 finite-difference evaluations of the most
+        expensive strained functional (VERDICT r3 item 10)."""
+        ctx = self.ctx
+        s = np.zeros((3, 3))
+        gk0 = np.asarray(ctx.gkvec.gkcart)
+        for ik in range(ctx.gkvec.num_kpoints):
+            dens = np.zeros(gk0.shape[1])
+            for ispn in range(psi.shape[1]):
+                dens += np.einsum(
+                    "b,bg->g", occ_w[ik, ispn],
+                    np.abs(np.asarray(psi[ik, ispn])) ** 2,
+                )
+            s -= np.einsum("g,ga,gb->ab", dens, gk0[ik], gk0[ik])
+        return 0.5 * (s + s.T) / ctx.unit_cell.omega
 
 
 def symmetrize_stress(ctx: SimulationContext, s: np.ndarray) -> np.ndarray:

