@@ -155,6 +155,11 @@ class HubbardConfig:
     nonlocal_: list = dataclasses.field(default_factory=list)
     local_constraint: list = dataclasses.field(default_factory=list)
     constraint_method: str = "energy"
+    constrained_calculation: bool = False
+    constraint_beta_mixing: float = 0.4
+    constraint_error: float = 1e-2
+    constraint_max_iteration: int = 10
+    constraint_strength: float = 1.0
 
 
 @dataclasses.dataclass

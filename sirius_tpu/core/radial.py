@@ -138,9 +138,14 @@ class RadialIntegralTable:
         num_q: int | None = None,
     ) -> "RadialIntegralTable":
         if num_q is None:
-            # reference density: ~20 points per unit q (settings.nprii_beta/
-            # nprii_aug = 20); coarser tables cost ~1e-5 Ha in total energy
-            num_q = max(128, int(qmax * 20) + 1)
+            # reference grid, EXACTLY (radial_integrals.hpp:54-57):
+            # span qmax + max(10, 0.1 qmax) with nprii (= 20 for beta/aug/
+            # wf) points per unit q — the ~1e-6-relative spline error of
+            # that spacing is part of the reference's numerical definition
+            # (test32's 2e-5 eval_sum sensitivity)
+            qspan = qmax + max(10.0, 0.1 * qmax)
+            num_q = int(20 * qspan)
+            qmax = qspan
         qgrid = np.linspace(0.0, qmax, num_q)
         tab = np.stack(
             [sbessel_integral(r, fn, int(l), qgrid, m=m) for fn, l in zip(functions, ls)]

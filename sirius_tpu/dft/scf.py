@@ -167,6 +167,8 @@ def run_scf(
     om_nl = None
     hub_lagrange = None
     hub_om_cons = None
+    hub_cons_state = {"err": np.inf, "steps": 0}
+    hub_cons_active = False
     e_hub = e_hub_one_el = 0.0
     if hub is not None:
         register_sym_ops(hub, ctx)
@@ -180,9 +182,10 @@ def run_scf(
             np.zeros((ns, 2 * e["il"] + 1, 2 * e["jl"] + 1), dtype=np.complex128)
             for e in hub.nonloc
         ]
+        hub_cons_active = hub_om_cons is not None
         um_local, um_nl, e_hub, e_hub_one_el = hubbard_potential_and_energy(
             hub, n0, ctx.max_occupancy, om_nl=om_nl0,
-            lagrange=hub_lagrange, om_cons=hub_om_cons,
+            lagrange=None, om_cons=None,
         )
         vhub = np.stack([
             u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
@@ -548,10 +551,10 @@ def run_scf(
 
                 om_nl_new = nonlocal_from_occ_T(hub, occ_T) if hub.nonloc else []
             # occupancy-constraint Lagrange multipliers (reference
-            # calculate_constraints_and_error, beta-mixed each iteration)
+            # calculate_constraints_and_error; RELEASES once converged)
             if hub_om_cons is not None:
-                hub_lagrange, _c_err, _ = constraint_update(
-                    hub, om_new, hub_lagrange, hub_om_cons, it
+                hub_lagrange, hub_cons_active = constraint_update(
+                    hub, om_new, hub_lagrange, hub_om_cons, hub_cons_state
                 )
             # one-electron term inside eval_sum: NEW occupancies against the
             # potential the band solve actually used (um_local/um_nl of the
@@ -636,7 +639,8 @@ def run_scf(
         if hub is not None:
             um_local, um_nl, e_hub, _ = hubbard_potential_and_energy(
                 hub, om_mixed, ctx.max_occupancy, om_nl=om_nl_mixed,
-                lagrange=hub_lagrange, om_cons=hub_om_cons,
+                lagrange=hub_lagrange if hub_cons_active else None,
+                om_cons=hub_om_cons if hub_cons_active else None,
             )
             vhub = np.stack([
                 u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
@@ -776,18 +780,29 @@ def run_scf(
     if cfg.control.print_forces and num_iter_done > 0:
         from sirius_tpu.dft.forces import total_forces
 
-        if hub is not None:
-            import warnings
-
-            warnings.warn(
-                "Hubbard force contribution is not yet implemented; forces "
-                "are inconsistent with the DFT+U total energy"
-            )
-
         fterms = total_forces(
             ctx, rho_g, pot.vxc_g, pot.veff_g, pot.bz_g, psi, occ_np, evals,
             d_by_spin, dm_blocks_by_spin, rho_resid_g=rho_resid_g,
         )
+        if hub is not None:
+            from sirius_tpu.dft.forces import forces_hubbard, symmetrize_forces
+
+            if hub.nonloc or getattr(
+                ctx.cfg.hubbard, "hubbard_subspace_method", "none"
+            ) == "full_orthogonalization":
+                import warnings
+
+                warnings.warn(
+                    "Hubbard force: the inter-site +V occupancy derivative "
+                    "and the full_orthogonalization O^{-1/2} derivative are "
+                    "not included (reference supports forces for the simple "
+                    "local correction only)"
+                )
+            fh = forces_hubbard(
+                ctx, hub, um_local, psi, occ_np, ctx.max_occupancy
+            )
+            fterms["hubbard"] = fh
+            fterms["total"] = symmetrize_forces(ctx, fterms["total"] + fh)
         result["forces"] = fterms["total"].tolist()
     if cfg.control.print_stress and num_iter_done > 0:
         from sirius_tpu.dft.stress import StressCalculator

@@ -58,12 +58,17 @@ class FpSpecies:
         r = rmin * (rmt / rmin) ** (np.arange(nrmt) / (nrmt - 1.0))
         aw_default, aw_specific = [], {}
         for v in d.get("valence", []):
+            # the principal quantum number sits at the VALENCE-ENTRY level
+            # ({"l": 0, "n": 4, "basis": [...]}, reference
+            # atom_type.cpp read_input aw descriptors); missing it made
+            # auto-enu resolve l+1 = CORE bands (NiO: O 1s as the l=0 APW)
+            n_v = int(v.get("n", 0))
             basis = [
                 BasisEntry(
                     enu=float(b.get("enu", 0.15)),
                     dme=int(b.get("dme", 0)),
                     auto=int(b.get("auto", 0)),
-                    n=int(b.get("n", 0)),
+                    n=int(b.get("n", n_v)),
                 )
                 for b in v["basis"]
             ]
@@ -79,7 +84,7 @@ class FpSpecies:
                         enu=float(b.get("enu", 0.15)),
                         dme=int(b.get("dme", 0)),
                         auto=int(b.get("auto", 0)),
-                        n=int(b.get("n", 0)),
+                        n=int(b.get("n", int(e.get("n", 0)))),
                     )
                     for b in e["basis"]
                 ],

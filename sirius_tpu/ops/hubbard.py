@@ -57,6 +57,7 @@ class HubbardData:
     phi_s_gk: np.ndarray  # (nk, nhub_tot, ngk) S-weighted orbitals
     blocks: list  # list[HubBlock]
     num_hub_total: int
+    phi_gk: np.ndarray | None = None  # bare orbitals (forces need them)
     simplified: bool = True
     nonloc: list = dataclasses.field(default_factory=list)
     # per nonlocal entry: dict(ia, ja, il, jl, ni, nj, T [3]int, V, iblk, jblk)
@@ -193,6 +194,7 @@ class HubbardData:
             sphi_all = s_apply(phi_all)
 
         phi_s = np.zeros((nk, nhub, ctx.gkvec.ngk_max), dtype=np.complex128)
+        phi_b = np.zeros_like(phi_s)
         for b in blocks:
             it = uc.type_of_atom[b.ia]
             t = uc.atom_types[it]
@@ -202,12 +204,13 @@ class HubbardData:
             )
             src = ao_index(b.ia, iw)
             phi_s[:, b.off : b.off + b.nm, :] = sphi_all[:, src : src + b.nm, :]
+            phi_b[:, b.off : b.off + b.nm, :] = phi_all[:, src : src + b.nm, :]
 
         # ---- nonlocal entries + translation set ----
         nonloc = []
         sym_maps = _symmetry_maps(ctx)
         trans_keys = set()
-        for e in getattr(cfg.hubbard, "nonlocal", None) or []:
+        for e in getattr(cfg.hubbard, "nonlocal_", None) or []:
             ia, ja = int(e["atom_pair"][0]), int(e["atom_pair"][1])
             il, jl = int(e["l"][0]), int(e["l"][1])
             ni, nj = int(e["n"][0]), int(e["n"][1])
@@ -235,6 +238,7 @@ class HubbardData:
 
         return HubbardData(
             phi_s_gk=phi_s, blocks=blocks, num_hub_total=nhub,
+            phi_gk=phi_b,
             simplified=bool(cfg.hubbard.simplified), nonloc=nonloc,
             trans=sorted(trans_keys), sym_maps=sym_maps, constraint=cons,
         )
@@ -618,19 +622,29 @@ def u_matrix_for_k(hub: HubbardData, um_local: np.ndarray, um_nl: list,
 
 
 def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
-                      it: int):
+                      state: dict):
     """One step of the occupancy-constraint loop (reference
-    Occupation_matrix::calculate_constraints_and_error): lambda += beta *
-    (om - om_ref); returns (lagrange, error, active)."""
+    Occupation_matrix::calculate_constraints_and_error +
+    Hubbard_matrix::apply_constraint): while ACTIVE (error above the
+    constraint_error threshold AND fewer than constraint_max_iteration
+    steps), lambda += beta * (om - om_ref). Once the occupancy is close
+    enough the constraint RELEASES — it is a starter that prepares the
+    occupancy, not a permanent penalty (reference hubbard_matrix.hpp:227).
+
+    state: {"err": float, "steps": int} carried by the SCF loop. Returns
+    (lagrange, active_for_next_potential)."""
     c = hub.constraint
     if c is None or om_cons is None:
-        return lagrange, 0.0, False
+        return lagrange, False
+    active = (
+        state["err"] > c["error"] and state["steps"] < c["max_iteration"]
+    )
+    if not active:
+        return lagrange, False
     if lagrange is None:
         lagrange = np.zeros_like(om)
-    active = it < c["max_iteration"]
     err = 0.0
     diff = om - om_cons
-    # only the constrained blocks (config local_constraint list) contribute
     mask = np.zeros_like(om, dtype=bool)
     for e in c["local"]:
         ia = int(e["atom_index"])
@@ -640,9 +654,12 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
         sl = slice(b.off, b.off + b.nm)
         mask[:, sl, sl] = True
         err = max(err, float(np.abs(diff[:, sl, sl]).max()))
-    if active:
-        lagrange = lagrange + c["beta_mixing"] * np.where(mask, diff, 0.0)
-    return lagrange, err, active
+    lagrange = lagrange + c["beta_mixing"] * np.where(mask, diff, 0.0)
+    state["err"] = err
+    state["steps"] += 1
+    # still active for the NEXT potential build?
+    nxt = err > c["error"] and state["steps"] < c["max_iteration"]
+    return lagrange, nxt
 
 
 def constraint_reference_matrix(hub: HubbardData, ns: int) -> np.ndarray | None:

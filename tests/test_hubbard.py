@@ -61,3 +61,153 @@ def test_energy_values():
     n = np.array([[[0.5 + 0j]]])
     _, _, e, _ = hubbard_potential_and_energy(hub, n)
     np.testing.assert_allclose(e, 0.5 / 4, atol=1e-14)
+
+
+def test_full_form_equals_dudarev_at_J0():
+    """With J=J0=0 the Liechtenstein 4-index form reduces EXACTLY to the
+    simplified (Dudarev) form in both potential and energy: the U n_total
+    terms cancel between the dc and the 4-index contraction."""
+    from sirius_tpu.ops.hubbard import hubbard_coulomb_matrix
+
+    rng = np.random.default_rng(5)
+    l, U = 2, 0.29
+    nm = 2 * l + 1
+    m = rng.standard_normal((nm, nm))
+    nb = (m + m.T) / 10 + np.eye(nm) * 0.4
+    n = np.stack([nb, 0.7 * nb]).astype(complex)
+
+    def make(simplified):
+        b = HubBlock(ia=0, off=0, nm=nm, l=l, n=3, U=U)
+        if not simplified:
+            b.hmat = hubbard_coulomb_matrix(l, U, 0.0)
+        hub = HubbardData(
+            phi_s_gk=np.zeros((1, nm, 1), dtype=complex), blocks=[b],
+            num_hub_total=nm, simplified=simplified,
+        )
+        return hubbard_potential_and_energy(hub, n)
+
+    v_s, _, e_s, e1_s = make(True)
+    v_f, _, e_f, e1_f = make(False)
+    np.testing.assert_allclose(v_f, v_s, atol=1e-12)
+    np.testing.assert_allclose(e_f, e_s, atol=1e-12)
+    np.testing.assert_allclose(e1_f, e1_s, atol=1e-12)
+
+
+def test_full_form_potential_is_energy_derivative_with_J():
+    """Full form with J != 0: V must still be dE/dn (collinear 2-spin)."""
+    from sirius_tpu.ops.hubbard import hubbard_coulomb_matrix
+
+    rng = np.random.default_rng(6)
+    l, U, J = 2, 0.3, 0.05
+    nm = 2 * l + 1
+    b = HubBlock(ia=0, off=0, nm=nm, l=l, n=3, U=U, J=J)
+    b.hmat = hubbard_coulomb_matrix(l, U, J)
+    hub = HubbardData(
+        phi_s_gk=np.zeros((1, nm, 1), dtype=complex), blocks=[b],
+        num_hub_total=nm, simplified=False,
+    )
+    m = rng.standard_normal((nm, nm))
+    nb = (m + m.T) / 10 + np.eye(nm) * 0.4
+    n = np.stack([nb, 0.6 * nb]).astype(complex)
+    v, _, e0, _ = hubbard_potential_and_energy(hub, n)
+    h = 1e-6
+    for (i, j) in [(0, 0), (1, 3), (2, 4)]:
+        dn = np.zeros_like(n)
+        dn[0, i, j] += h
+        dn[0, j, i] += h
+        ep = hubbard_potential_and_energy(hub, n + dn)[2]
+        em = hubbard_potential_and_energy(hub, n - dn)[2]
+        fd = (ep - em) / (2 * h)
+        an = float(np.real(v[0, i, j] + v[0, j, i]))
+        np.testing.assert_allclose(an, fd, atol=1e-6)
+
+
+def test_nonlocal_potential_is_energy_derivative():
+    """+V term: um_nl = -V om_nl must be dE_nl/d(om_nl)."""
+    rng = np.random.default_rng(7)
+    b1 = HubBlock(ia=0, off=0, nm=5, l=2, n=3, U=0.3)
+    b2 = HubBlock(ia=1, off=5, nm=3, l=1, n=2, U=0.0)
+    hub = HubbardData(
+        phi_s_gk=np.zeros((1, 8, 1), dtype=complex), blocks=[b1, b2],
+        num_hub_total=8, simplified=True,
+        nonloc=[dict(ia=0, ja=1, il=2, jl=1, ni=3, nj=2,
+                     T=np.array([0, 0, 0]), V=0.037)],
+    )
+    n = np.zeros((2, 8, 8), dtype=complex)
+    onl = [(rng.standard_normal((2, 5, 3)) * 0.1).astype(complex)]
+    _, um_nl, e0, _ = hubbard_potential_and_energy(hub, n, om_nl=onl)
+    h = 1e-6
+    for (s, i, j) in [(0, 0, 0), (1, 3, 2)]:
+        d = [o.copy() for o in onl]
+        d[0][s, i, j] += h
+        ep = hubbard_potential_and_energy(hub, n, om_nl=d)[2]
+        d[0][s, i, j] -= 2 * h
+        em = hubbard_potential_and_energy(hub, n, om_nl=d)[2]
+        fd = (ep - em) / (2 * h)
+        an = float(np.real(um_nl[0][s, i, j]))
+        np.testing.assert_allclose(an, fd, atol=1e-6)
+
+
+def test_forces_hubbard_matches_occupancy_fd():
+    """F_hub must equal -d/dR [sum um . n(R)] at frozen psi/um: finite
+    difference over the hubbard-orbital tables on a synthetic US cell
+    (the check that catches wrong derivative attribution in the
+    phi^S = phi + beta q <beta|phi> chain)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import sirius_tpu.crystal.unit_cell as ucm
+    from sirius_tpu.dft.forces import forces_hubbard
+    from sirius_tpu.ops.hubbard import HubbardData
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    rng = np.random.default_rng(11)
+
+    def build(positions):
+        ctx = synthetic_silicon_context(
+            gk_cutoff=4.0, pw_cutoff=12.0, ngridk=(1, 1, 1), num_bands=6,
+            use_symmetry=False, positions=positions,
+            extra_params={"hubbard_correction": True},
+        )
+        # synthetic hubbard config on atom 0's l=1 atomic wf
+        ctx.cfg.hubbard.local = [
+            {"atom_type": ctx.unit_cell.atom_types[0].label, "l": 1, "n": 2,
+             "U": 0.25, "total_initial_occupancy": 2}
+        ]
+        ctx.cfg.hubbard.simplified = True
+        hub = HubbardData.build(ctx)
+        return ctx, hub
+
+    pos0 = np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]])
+    ctx, hub = build(pos0)
+    nb, ngk = 6, ctx.gkvec.ngk_max
+    psi = (
+        rng.standard_normal((1, 1, nb, ngk))
+        + 1j * rng.standard_normal((1, 1, nb, ngk))
+    ) * np.asarray(ctx.gkvec.mask)[:, None, None, :]
+    occ = np.zeros((1, 1, nb))
+    occ[0, 0, :4] = 2.0
+    um = rng.standard_normal((1, hub.num_hub_total, hub.num_hub_total))
+    um = 0.5 * (um + um.transpose(0, 2, 1)).astype(complex)
+
+    def e_of(positions):
+        c2, h2 = build(positions)
+        from sirius_tpu.ops.hubbard import occupation_matrix
+
+        om, _ = occupation_matrix(c2, h2, psi, occ, 2.0)
+        return 2.0 * float(np.real(np.sum(um[0] * np.conj(om[0]))))
+
+    F = forces_hubbard(ctx, hub, um, psi, occ, 2.0)
+    h = 1e-5
+    for (ia, x) in [(0, 0), (0, 2), (1, 1)]:
+        dp = pos0.copy()
+        # displace in CARTESIAN: convert the cartesian step to fractional
+        step = np.zeros(3)
+        step[x] = h
+        frac = step @ np.linalg.inv(ctx.unit_cell.lattice)
+        dp[ia] = pos0[ia] + frac
+        ep = e_of(dp)
+        dp[ia] = pos0[ia] - frac
+        em = e_of(dp)
+        fd = -(ep - em) / (2 * h)
+        np.testing.assert_allclose(F[ia, x], fd, atol=2e-5, rtol=1e-4)

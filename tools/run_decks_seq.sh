@@ -1,0 +1,13 @@
+#!/bin/bash
+# Sequential full-deck regeneration in priority order (cheap + previously
+# passing first so DECKS.json fills up front; heavy 4x4x4 Hubbard decks
+# last). One deck at a time — parallel deck runs contend for cores and
+# slow each other 2-4x. Usage: nohup bash tools/run_decks_seq.sh &
+cd /root/repo
+ORDER="test23 test08 test15 test02 test31 test04 test14 test32 test01 test20 test03 test06 test07 test05 test12 test16 test30 test28 test27 test21 test09 test10 test11 test17 test18 test19 test29 test22 test26 test24 test25"
+for t in $ORDER; do
+  echo "[decks] $t start $(date +%H:%M:%S)" >> /tmp/decks_seq.log
+  timeout 7200 python tools/run_decks.py "$t" >> /tmp/decks_seq.log 2>&1
+  echo "[decks] $t done  $(date +%H:%M:%S)" >> /tmp/decks_seq.log
+done
+echo "[decks] ALL DONE $(date)" >> /tmp/decks_seq.log
