@@ -108,5 +108,80 @@ module sirius_tpu
             real(c_double), dimension(9), intent(out) :: stress
             integer(c_int), intent(out) :: error_code
         end subroutine
+
+        subroutine sirius_option_get_number_of_sections(length, error_code) &
+                bind(C, name="sirius_option_get_number_of_sections")
+            import :: c_int
+            integer(c_int), intent(out) :: length
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_option_get_section_name(elem, section_name, &
+                section_name_length, error_code) &
+                bind(C, name="sirius_option_get_section_name")
+            import :: c_int, c_char
+            integer(c_int), value :: elem
+            character(kind=c_char), dimension(*), intent(out) :: section_name
+            integer(c_int), value :: section_name_length
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_option_get_section_length(section, length, &
+                error_code) bind(C, name="sirius_option_get_section_length")
+            import :: c_int, c_char
+            character(kind=c_char), dimension(*), intent(in) :: section
+            integer(c_int), intent(out) :: length
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_get_gkvec_arrays(handler, ik, num_gkvec, &
+                gvec_index, gkvec, gkvec_cart, gkvec_len, gkvec_tp, &
+                error_code) bind(C, name="sirius_get_gkvec_arrays")
+            import :: c_ptr, c_int, c_double
+            type(c_ptr), value :: handler
+            integer(c_int), intent(in) :: ik
+            integer(c_int), intent(out) :: num_gkvec
+            integer(c_int), dimension(*), intent(out) :: gvec_index
+            real(c_double), dimension(*), intent(out) :: gkvec
+            real(c_double), dimension(*), intent(out) :: gkvec_cart
+            real(c_double), dimension(*), intent(out) :: gkvec_len
+            real(c_double), dimension(*), intent(out) :: gkvec_tp
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_get_rg_values(handler, label, values, error_code) &
+                bind(C, name="sirius_get_rg_values")
+            import :: c_ptr, c_char, c_double, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            real(c_double), dimension(*), intent(out) :: values
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_rg_values(handler, label, values, num_points, &
+                error_code) bind(C, name="sirius_set_rg_values")
+            import :: c_ptr, c_char, c_double, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            real(c_double), dimension(*), intent(in) :: values
+            integer(c_int), intent(in) :: num_points
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_save_state(handler, file_name, error_code) &
+                bind(C, name="sirius_save_state")
+            import :: c_ptr, c_char, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: file_name
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_load_state(handler, file_name, error_code) &
+                bind(C, name="sirius_load_state")
+            import :: c_ptr, c_char, c_int
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: file_name
+            integer(c_int), intent(out) :: error_code
+        end subroutine
     end interface
 end module sirius_tpu
