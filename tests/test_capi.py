@@ -66,3 +66,28 @@ def test_capi_python_bridge_roundtrip():
         assert capi.get_num_atoms(h) == 1
     finally:
         capi.free_handle(h)
+
+
+def test_option_introspection():
+    from sirius_tpu import capi
+
+    ns = capi.option_get_number_of_sections()
+    assert ns >= 7
+    names = [capi.option_get_section_name(i + 1) for i in range(ns)]
+    assert "parameters" in names and "mixer" in names
+    nlen = capi.option_get_section_length("parameters")
+    assert nlen > 10
+    info = capi.option_get_info("parameters", 1)
+    assert info["name"] and 1 <= info["type"] <= 14
+    assert capi.option_get("mixer", "beta") is not None
+
+
+def test_callback_registration_unknown_is_tolerated():
+    from sirius_tpu import capi
+
+    h = capi.create_context()
+    # unknown hook names are accepted and ignored (reference tolerates
+    # unused callbacks); no ctypes wrapping happens for them
+    capi.set_callback_function(h, "totally_unknown_hook", 0)
+    assert capi._handles[h]["callbacks"]["totally_unknown_hook"] is None
+    capi.free_handle(h)
