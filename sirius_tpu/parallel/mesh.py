@@ -52,24 +52,49 @@ def kset_spec() -> P:
 def production_mesh(nk: int, nb: int):
     """Mesh for the production SCF on however many devices are present.
 
-    Factors the device count as num_k x num_b with num_k = gcd(nk, ndev)
-    (k-parallelism first — embarrassingly parallel band solves); bands are
-    sharded only when nb divides evenly, otherwise replicated over "b".
-    Returns (mesh, psi_spec) or (None, None) on a single device — callers
-    keep the exact single-device code path in that case."""
+    Chooses (num_k, num_b) with num_k | nk, num_b | nb and
+    num_k * num_b <= ndev maximizing the used device count (k first on
+    ties — band solves are embarrassingly parallel over k). The mesh may
+    be PARTIAL (a subset of devices): real parallelism on fewer devices
+    beats a full-device mesh with replicated axes. Returns
+    (mesh, psi_spec) or (None, None) when no parallel factorization
+    exists — callers keep the exact single-device path then.
+
+    Multi-process (multi-host) runs require every process's devices in
+    the mesh, so partial meshes are limited to single-process sessions;
+    multi-host falls back to the full-device gcd factorization."""
     import math
 
     ndev = len(jax.devices())
     if ndev <= 1:
         return None, None
-    num_k = math.gcd(max(nk, 1), ndev)
-    num_b = ndev // num_k
-    band_ax = "b" if (num_b > 1 and nb % num_b == 0) else None
-    if num_k == 1 and band_ax is None:
-        # fully-replicated degenerate case (nk coprime with ndev and nb
-        # does not divide): no parallelism to gain, keep single-device path
+    nk = max(nk, 1)
+    nb = max(nb, 1)
+    multi_host = jax.process_count() > 1
+    if multi_host:
+        num_k = math.gcd(nk, ndev)
+        num_b = math.gcd(nb, ndev // num_k)
+        # full-device mesh with possibly-replicated band axis
+        mesh = make_mesh(num_k=num_k, num_b=ndev // num_k)
+        band_ax = "b" if (ndev // num_k > 1 and nb % (ndev // num_k) == 0) else None
+        if num_k == 1 and band_ax is None:
+            return None, None
+        return mesh, P("k", None, band_ax, None)
+    best = (1, 1)
+    for dk in range(1, min(nk, ndev) + 1):
+        if nk % dk:
+            continue
+        db = math.gcd(nb, ndev // dk)
+        if dk * db > best[0] * best[1] or (
+            dk * db == best[0] * best[1] and dk > best[0]
+        ):
+            best = (dk, db)
+    num_k, num_b = best
+    if num_k * num_b == 1:
         return None, None
-    mesh = make_mesh(num_k=num_k, num_b=num_b)
+    devs = np.array(jax.devices()[: num_k * num_b])
+    mesh = Mesh(devs.reshape(num_k, num_b), ("k", "b"))
+    band_ax = "b" if num_b > 1 else None
     return mesh, P("k", None, band_ax, None)
 
 
