@@ -676,12 +676,13 @@ def constraint_reference_matrix(hub: HubbardData, ns: int) -> np.ndarray | None:
         b = hub.find_block(ia, n, l)
         occ = np.asarray(e["occupancy"], dtype=float)
         order = [int(m) for m in e.get("lm_order", range(-l, l + 1))]
-        # map stored index -> m index within the block (m from -l..l)
-        idx = [m + l for m in order]
+        # internal slot m1 draws FROM stored slot l+lm_order[m1]
+        # (reference hubbard_matrix.cpp:95: cons(m2,m1) =
+        #  occ[l+lm_order[m1]][l+lm_order[m2]])
         for ispn in range(min(ns, occ.shape[0])):
             blk = np.zeros((b.nm, b.nm))
-            for i1, j1 in enumerate(idx):
-                for i2, j2 in enumerate(idx):
-                    blk[j1, j2] = occ[ispn][i1][i2]
+            for m1 in range(b.nm):
+                for m2 in range(b.nm):
+                    blk[m2, m1] = occ[ispn][l + order[m1]][l + order[m2]]
             om[ispn, b.off : b.off + b.nm, b.off : b.off + b.nm] = blk
     return om
