@@ -676,6 +676,12 @@ def constraint_reference_matrix(hub: HubbardData, ns: int) -> np.ndarray | None:
         b = hub.find_block(ia, n, l)
         occ = np.asarray(e["occupancy"], dtype=float)
         order = [int(m) for m in e.get("lm_order", range(-l, l + 1))]
+        if len(order) != b.nm or occ.shape[-1] != b.nm:
+            raise ValueError(
+                f"local_constraint for atom {ia} l={l}: lm_order and the "
+                f"occupancy matrix must cover the full 2l+1={b.nm} block "
+                f"(got lm_order len {len(order)}, occupancy {occ.shape})"
+            )
         # internal slot m1 draws FROM stored slot l+lm_order[m1]
         # (reference hubbard_matrix.cpp:95: cons(m2,m1) =
         #  occ[l+lm_order[m1]][l+lm_order[m2]])

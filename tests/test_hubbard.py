@@ -211,3 +211,46 @@ def test_forces_hubbard_matches_occupancy_fd():
         em = e_of(dp)
         fd = -(ep - em) / (2 * h)
         np.testing.assert_allclose(F[ia, x], fd, atol=2e-5, rtol=1e-4)
+
+
+def test_constraint_reference_matrix_lm_order():
+    """Pin the reference lm_order convention (hubbard_matrix.cpp:95):
+    internal slot m1 draws FROM stored slot l+lm_order[m1], transposed
+    into (m2, m1) layout."""
+    from sirius_tpu.ops.hubbard import constraint_reference_matrix
+
+    l = 1
+    stored = np.array([[1.0, 0.2, 0.3], [0.2, 2.0, 0.4], [0.3, 0.4, 3.0]])
+    hub = HubbardData(
+        phi_s_gk=np.zeros((1, 3, 1), dtype=complex),
+        blocks=[HubBlock(ia=0, off=0, nm=3, l=l, n=2, U=0.1)],
+        num_hub_total=3,
+        constraint={
+            "local": [{
+                "atom_index": 0, "l": l, "n": 2,
+                "lm_order": [0, -1, 1],
+                "occupancy": [stored.tolist()],
+            }],
+            "strength": 1.0, "beta_mixing": 0.4,
+            "error": 0.1, "max_iteration": 10, "method": "energy",
+        },
+    )
+    om = constraint_reference_matrix(hub, 1)
+    want = np.zeros((3, 3))
+    order = [0, -1, 1]
+    for m1 in range(3):
+        for m2 in range(3):
+            want[m2, m1] = stored[l + order[m1], l + order[m2]]
+    np.testing.assert_allclose(om[0].real, want, atol=1e-14)
+    # diag: internal slot i holds stored slot l+order[i] -> [s11, s00, s22]
+    np.testing.assert_allclose(
+        np.diag(om[0].real), [stored[1, 1], stored[0, 0], stored[2, 2]],
+        atol=1e-14,
+    )
+
+    # partial lm_order is rejected loudly
+    hub.constraint["local"][0]["lm_order"] = [0]
+    hub.constraint["local"][0]["occupancy"] = [[[0.5]]]
+    import pytest
+    with pytest.raises(ValueError):
+        constraint_reference_matrix(hub, 1)
