@@ -540,10 +540,24 @@ void sirius_option_get_number_of_sections(int* length, int* error_code)
     PyGILState_Release(st);
 }
 
-static void copy_str(PyObject* r, char* out, int out_len)
+static bool copy_str(PyObject* r, char* out, int out_len)
 {
-    const char* s = PyUnicode_AsUTF8(r);
+    /* tolerates r == NULL (missing dict key) — copies "" and reports
+     * false so callers that REQUIRE the field can flag the error */
+    const char* s = r ? PyUnicode_AsUTF8(r) : nullptr;
+    if (!s) PyErr_Clear();
     std::snprintf(out, (size_t)out_len, "%s", s ? s : "");
+    return s != nullptr;
+}
+
+/* PyLong_AsLong with NULL/err tolerance: missing or non-int dict items
+ * report through *ok instead of segfaulting the host process */
+static long as_long_checked(PyObject* o, bool* ok)
+{
+    if (!o) { *ok = false; return 0; }
+    long v = PyLong_AsLong(o);
+    if (v == -1 && PyErr_Occurred()) { PyErr_Clear(); *ok = false; return 0; }
+    return v;
 }
 
 void sirius_option_get_section_name(int elem, char* section_name, int section_name_length, int* error_code)
@@ -577,13 +591,13 @@ void sirius_option_get_info(char const* section, int elem, char* key_name, int k
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("option_get_info", Py_BuildValue("(si)", section, elem));
     if (r && PyDict_Check(r)) {
-        copy_str(PyDict_GetItemString(r, "name"), key_name, key_name_len);
-        *type = (int)PyLong_AsLong(PyDict_GetItemString(r, "type"));
-        *length = (int)PyLong_AsLong(PyDict_GetItemString(r, "length"));
-        *enum_size = (int)PyLong_AsLong(PyDict_GetItemString(r, "enum_size"));
+        bool ok = copy_str(PyDict_GetItemString(r, "name"), key_name, key_name_len);
+        *type = (int)as_long_checked(PyDict_GetItemString(r, "type"), &ok);
+        *length = (int)as_long_checked(PyDict_GetItemString(r, "length"), &ok);
+        *enum_size = (int)as_long_checked(PyDict_GetItemString(r, "enum_size"), &ok);
         copy_str(PyDict_GetItemString(r, "title"), title, title_len);
         copy_str(PyDict_GetItemString(r, "description"), description, description_len);
-        set_err(error_code, 0);
+        set_err(error_code, ok ? 0 : 1);
     } else {
         set_err(error_code, 1);
     }
@@ -602,13 +616,24 @@ void sirius_get_gkvec_arrays(void* handler, int const* ik, int* num_gkvec, int* 
     PyObject* r = call("get_gkvec_arrays",
                        Py_BuildValue("(li)", reinterpret_cast<long>(handler), *ik));
     if (r && PyDict_Check(r)) {
-        int n = (int)PyLong_AsLong(PyDict_GetItemString(r, "num_gkvec"));
+        bool ok = true;
+        int n = (int)as_long_checked(PyDict_GetItemString(r, "num_gkvec"), &ok);
         *num_gkvec = n;
         PyObject* gi = PyDict_GetItemString(r, "gvec_index");
         PyObject* gf = PyDict_GetItemString(r, "gkvec");
         PyObject* gc = PyDict_GetItemString(r, "gkvec_cart");
         PyObject* gl = PyDict_GetItemString(r, "gkvec_len");
         PyObject* gt = PyDict_GetItemString(r, "gkvec_tp");
+        if (!ok || !gi || !gf || !gc || !gl || !gt ||
+            PyList_Size(gi) < n || PyList_Size(gl) < n ||
+            PyList_Size(gf) < 3 * n || PyList_Size(gc) < 3 * n ||
+            PyList_Size(gt) < 2 * n) {
+            PyErr_Clear(); /* PyList_Size on a non-list sets SystemError */
+            set_err(error_code, 1);
+            Py_XDECREF(r);
+            PyGILState_Release(st);
+            return;
+        }
         for (int i = 0; i < n; i++) {
             gvec_index[i] = (int)PyLong_AsLong(PyList_GetItem(gi, i));
             gkvec_len[i] = PyFloat_AsDouble(PyList_GetItem(gl, i));

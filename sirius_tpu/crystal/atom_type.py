@@ -108,6 +108,13 @@ class AtomType:
 
     @staticmethod
     def from_file(label: str, path: str) -> "AtomType":
+        if path.lower().endswith(".upf"):
+            # raw UPF v2: convert in-process (same code path as the
+            # sirius-upf-to-json CLI); deck dirs may be read-only, so the
+            # converted dict stays in memory
+            from sirius_tpu.io.upf import upf2_to_json
+
+            return AtomType.from_dict(label, upf2_to_json(path))
         with open(path) as f:
             data = json.load(f)
         return AtomType.from_dict(label, data)

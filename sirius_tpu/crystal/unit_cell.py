@@ -54,10 +54,12 @@ class UnitCell:
             if (not path.lower().endswith(".json")) and os.path.exists(path + ".json"):
                 # decks may reference a raw UPF name with a converted
                 # <name>.json alongside; prefer the JSON (the converter in
-                # tools/upf_to_json.py produces the same layout)
+                # io/upf.py produces the same layout)
                 path = path + ".json"
             elif not os.path.exists(path) and os.path.exists(path + ".json"):
                 path = path + ".json"
+            # raw .UPF paths with no converted sibling fall through:
+            # AtomType.from_file converts them in-process
             types.append(AtomType.from_file(lbl, path))
             type_index[lbl] = len(types) - 1
         t_of_a, pos, mom = [], [], []

@@ -712,6 +712,13 @@ def run_scf(
             # each SCF step); recorded from the OUTPUT density pre-mix
             mag_history.append(float(np.real(mag_new[0]) * ctx.unit_cell.omega))
         num_iter_done = it + 1
+        if cfg.control.verbosity >= 2:
+            # reference per-iteration SCF line (dft_ground_state verbosity 2)
+            mg = f" mag={mag_history[-1]:+.4f}" if polarized else ""
+            print(
+                f"[scf] it={it + 1:3d} etot={e_total:+.10f} rms={rms:.3e}{mg}",
+                flush=True,
+            )
 
         de = abs(e_total - e_prev) if e_prev is not None else np.inf
         e_prev = e_total
@@ -815,19 +822,26 @@ def run_scf(
             if hub.nonloc or getattr(
                 ctx.cfg.hubbard, "hubbard_subspace_method", "none"
             ) == "full_orthogonalization":
+                # the inter-site +V occupancy derivative and the
+                # full_orthogonalization O^{-1/2} derivative are not
+                # implemented; adding the bare-phi local term on top of
+                # orbitals that were actually O^{-1/2}-mixed would be
+                # inconsistent — skip the Hubbard force entirely (the
+                # reference computes forces only for the simple local
+                # correction, hubbard_occupancies_derivatives.cpp)
                 import warnings
 
                 warnings.warn(
-                    "Hubbard force: the inter-site +V occupancy derivative "
-                    "and the full_orthogonalization O^{-1/2} derivative are "
-                    "not included (reference supports forces for the simple "
-                    "local correction only)"
+                    "Hubbard force term SKIPPED: +V / full_orthogonalization "
+                    "occupancy derivatives are not implemented; reported "
+                    "forces omit the Hubbard contribution"
                 )
-            fh = forces_hubbard(
-                ctx, hub, um_local, psi, occ_np, ctx.max_occupancy
-            )
-            fterms["hubbard"] = fh
-            fterms["total"] = symmetrize_forces(ctx, fterms["total"] + fh)
+            else:
+                fh = forces_hubbard(
+                    ctx, hub, um_local, psi, occ_np, ctx.max_occupancy
+                )
+                fterms["hubbard"] = fh
+                fterms["total"] = symmetrize_forces(ctx, fterms["total"] + fh)
         result["forces"] = fterms["total"].tolist()
     if cfg.control.print_stress and num_iter_done > 0:
         from sirius_tpu.dft.stress import StressCalculator

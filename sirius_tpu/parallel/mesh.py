@@ -73,8 +73,9 @@ def production_mesh(nk: int, nb: int):
     multi_host = jax.process_count() > 1
     if multi_host:
         num_k = math.gcd(nk, ndev)
-        num_b = math.gcd(nb, ndev // num_k)
-        # full-device mesh with possibly-replicated band axis
+        # full-device mesh (multi-host requires every device present); the
+        # band axis is sized ndev//num_k and only USED when nb divides it —
+        # otherwise the "b" axis replicates (spec None below) by design
         mesh = make_mesh(num_k=num_k, num_b=ndev // num_k)
         band_ax = "b" if (ndev // num_k > 1 and nb % (ndev // num_k) == 0) else None
         if num_k == 1 and band_ax is None:
