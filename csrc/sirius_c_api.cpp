@@ -180,6 +180,117 @@ void sirius_add_atom_type(void* handler, char const* label,
     PyGILState_Release(st);
 }
 
+/* full reference signature with optional zn/symbol/mass/spin_orbit
+ * (sirius_api.cpp:1906-1944); pass fname = "" or NULL for an array-based
+ * species completed by the radial-function entries below */
+void sirius_add_atom_type_ex(void* handler, char const* label, char const* fname,
+                             int const* zn, char const* symbol, double const* mass,
+                             int const* spin_orbit, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("add_atom_type",
+                       Py_BuildValue("(lssisdi)", reinterpret_cast<long>(handler),
+                                     label, fname ? fname : "", zn ? *zn : 0,
+                                     symbol ? symbol : "", mass ? *mass : 0.0,
+                                     (spin_orbit && *spin_orbit) ? 1 : 0));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+/* new list from n doubles */
+static PyObject* dlist(double const* a, int n)
+{
+    PyObject* l = PyList_New(n);
+    for (int i = 0; i < n; i++) {
+        PyList_SetItem(l, i, PyFloat_FromDouble(a[i]));
+    }
+    return l;
+}
+
+void sirius_set_atom_type_radial_grid(void* handler, char const* label,
+                                      int const* num_points, double const* grid,
+                                      int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_atom_type_radial_grid",
+                       Py_BuildValue("(lsN)", reinterpret_cast<long>(handler),
+                                     label, dlist(grid, *num_points)));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+/* reference sirius_add_atom_type_radial_function (sirius_api.cpp:2058):
+ * rf_label selects beta / ps_atomic_wf / ps_rho_core / ps_rho_total /
+ * vloc / q_aug / ae_paw_wf / ps_paw_wf / ae_paw_core / ae_rho; n, l, occ
+ * optional (pass NULL); idxrf1/idxrf2 1-based for q_aug */
+void sirius_add_atom_type_radial_function(void* handler, char const* atom_type,
+                                          char const* rf_label, double const* rf,
+                                          int const* num_points, int const* n,
+                                          int const* l, int const* idxrf1,
+                                          int const* idxrf2, double const* occ,
+                                          int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("add_atom_type_radial_function",
+                       Py_BuildValue("(lssNiiiid)", reinterpret_cast<long>(handler),
+                                     atom_type, rf_label, dlist(rf, *num_points),
+                                     n ? *n : -1, l ? *l : -1,
+                                     idxrf1 ? *idxrf1 : 0, idxrf2 ? *idxrf2 : 0,
+                                     occ ? *occ : 0.0));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_set_atom_type_dion(void* handler, char const* label,
+                               int const* num_beta, double const* dion,
+                               int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_atom_type_dion",
+                       Py_BuildValue("(lsN)", reinterpret_cast<long>(handler), label,
+                                     dlist(dion, (*num_beta) * (*num_beta))));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_set_atom_type_paw(void* handler, char const* label,
+                              double const* core_energy, double const* occupations,
+                              int const* num_occ, int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_atom_type_paw",
+                       Py_BuildValue("(lsdN)", reinterpret_cast<long>(handler), label,
+                                     *core_energy, dlist(occupations, *num_occ)));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
+void sirius_set_atom_type_hubbard(void* handler, char const* label, int const* l,
+                                  int const* n, double const* occ, double const* U,
+                                  double const* J, double const* alpha,
+                                  double const* beta, double const* J0,
+                                  int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* r = call("set_atom_type_hubbard",
+             Py_BuildValue("(lsiidddddd)", reinterpret_cast<long>(handler), label,
+                           *l, *n, *occ, *U, *J, *alpha, *beta, *J0));
+    Py_XDECREF(r);
+    set_err(error_code, r ? 0 : 1);
+    PyGILState_Release(st);
+}
+
 void sirius_add_atom(void* handler, char const* label, double const* pos,
                      double const* vector_field, int* error_code)
 {

@@ -67,6 +67,76 @@ module sirius_tpu
             integer(c_int), intent(out) :: error_code
         end subroutine
 
+        subroutine sirius_add_atom_type_ex(handler, label, fname, zn, &
+                symbol, mass, spin_orbit, error_code) &
+                bind(C, name="sirius_add_atom_type_ex")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label, fname
+            integer(c_int), intent(in) :: zn
+            character(kind=c_char), dimension(*), intent(in) :: symbol
+            real(c_double), intent(in) :: mass
+            integer(c_int), intent(in) :: spin_orbit
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_atom_type_radial_grid(handler, label, &
+                num_points, grid, error_code) &
+                bind(C, name="sirius_set_atom_type_radial_grid")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            integer(c_int), intent(in) :: num_points
+            real(c_double), dimension(*), intent(in) :: grid
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_add_atom_type_radial_function(handler, atom_type, &
+                rf_label, rf, num_points, n, l, idxrf1, idxrf2, occ, &
+                error_code) bind(C, name="sirius_add_atom_type_radial_function")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: atom_type
+            character(kind=c_char), dimension(*), intent(in) :: rf_label
+            real(c_double), dimension(*), intent(in) :: rf
+            integer(c_int), intent(in) :: num_points, n, l, idxrf1, idxrf2
+            real(c_double), intent(in) :: occ
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_atom_type_dion(handler, label, num_beta, &
+                dion, error_code) bind(C, name="sirius_set_atom_type_dion")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            integer(c_int), intent(in) :: num_beta
+            real(c_double), dimension(*), intent(in) :: dion
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_atom_type_paw(handler, label, core_energy, &
+                occupations, num_occ, error_code) &
+                bind(C, name="sirius_set_atom_type_paw")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            real(c_double), intent(in) :: core_energy
+            real(c_double), dimension(*), intent(in) :: occupations
+            integer(c_int), intent(in) :: num_occ
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_set_atom_type_hubbard(handler, label, l, n, occ, &
+                U, J, alpha, beta, J0, error_code) &
+                bind(C, name="sirius_set_atom_type_hubbard")
+            import :: c_ptr, c_char, c_int, c_double
+            type(c_ptr), value :: handler
+            character(kind=c_char), dimension(*), intent(in) :: label
+            integer(c_int), intent(in) :: l, n
+            real(c_double), intent(in) :: occ, U, J, alpha, beta, J0
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
         subroutine sirius_add_atom(handler, label, pos, vector_field, &
                 error_code) bind(C, name="sirius_add_atom")
             import :: c_ptr, c_char, c_double, c_int

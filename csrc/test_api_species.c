@@ -1,0 +1,154 @@
+/* C host proving ARRAY-BASED species construction (the QE embedding
+ * contract, reference sirius_api.cpp:2058-2338): the Si ultrasoft
+ * pseudopotential is pushed entirely through
+ *   sirius_add_atom_type_ex (no file name)
+ *   sirius_set_atom_type_radial_grid
+ *   sirius_add_atom_type_radial_function (vloc/beta/q_aug/ps_atomic_wf/
+ *                                         ps_rho_total/ps_rho_core)
+ *   sirius_set_atom_type_dion
+ * — NO species file is read at run time — then a full SCF runs on the
+ * test08 Si diamond cell and the total energy is compared to the deck's
+ * recorded reference value.
+ * Usage: test_api_species <expected_total> <tolerance>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "gen/si_species.h"
+
+void sirius_initialize(const int*, int*);
+void sirius_finalize(const int*, int*);
+void sirius_create_context(void**, int*);
+void sirius_import_parameters(void*, const char*, int*);
+void sirius_set_lattice_vectors(void*, const double*, const double*,
+                                const double*, int*);
+void sirius_add_atom_type_ex(void*, const char*, const char*, const int*,
+                             const char*, const double*, const int*, int*);
+void sirius_set_atom_type_radial_grid(void*, const char*, const int*,
+                                      const double*, int*);
+void sirius_add_atom_type_radial_function(void*, const char*, const char*,
+                                          const double*, const int*,
+                                          const int*, const int*, const int*,
+                                          const int*, const double*, int*);
+void sirius_set_atom_type_dion(void*, const char*, const int*, const double*,
+                               int*);
+void sirius_add_atom(void*, const char*, const double*, const double*, int*);
+void sirius_find_ground_state(void*, int*);
+void sirius_get_energy(void*, const char*, double*, int*);
+
+#define CHECK(what)                                                    \
+    if (err) {                                                         \
+        fprintf(stderr, "FAIL: %s (error_code %d)\n", what, err);      \
+        return 1;                                                      \
+    }
+
+static const char* params =
+    "{\"parameters\": {\"electronic_structure_method\": \"pseudopotential\","
+    " \"num_fv_states\": 8, \"xc_functionals\": [\"XC_LDA_X\", \"XC_LDA_C_PZ\"],"
+    " \"smearing_width\": 0.025, \"use_symmetry\": true, \"num_mag_dims\": 0,"
+    " \"gk_cutoff\": 6.0, \"pw_cutoff\": 20.0, \"energy_tol\": 1e-08,"
+    " \"density_tol\": 1e-06, \"num_dft_iter\": 100, \"ngridk\": [1, 1, 1],"
+    " \"gamma_point\": false}}";
+
+int main(int argc, char** argv)
+{
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <expected_total> <tol>\n", argv[0]);
+        return 2;
+    }
+    double expect = atof(argv[1]);
+    double tol = atof(argv[2]);
+
+    int err = 0, zero = 0;
+    sirius_initialize(&zero, &err);
+    CHECK("initialize");
+
+    void* h = NULL;
+    sirius_create_context(&h, &err);
+    CHECK("create_context");
+    sirius_import_parameters(h, params, &err);
+    CHECK("import_parameters");
+
+    double a1[3] = {0.0, 5.13, 5.13};
+    double a2[3] = {5.13, 0.0, 5.13};
+    double a3[3] = {5.13, 5.13, 0.0};
+    sirius_set_lattice_vectors(h, a1, a2, a3, &err);
+    CHECK("set_lattice_vectors");
+
+    /* ---- species from arrays only ---- */
+    int zn = SI_ZN;
+    sirius_add_atom_type_ex(h, "Si", "", &zn, SI_SYMBOL, NULL, NULL, &err);
+    CHECK("add_atom_type_ex");
+    int nr = SI_NR;
+    sirius_set_atom_type_radial_grid(h, "Si", &nr, SI_grid, &err);
+    CHECK("set_atom_type_radial_grid");
+    sirius_add_atom_type_radial_function(h, "Si", "vloc", SI_vloc, &nr, NULL,
+                                         NULL, NULL, NULL, NULL, &err);
+    CHECK("vloc");
+
+    for (int i = 0; i < SI_NBETA; i++) {
+        sirius_add_atom_type_radial_function(h, "Si", "beta", SI_betas[i],
+                                             &SI_beta_nr[i], NULL,
+                                             &SI_beta_l[i], NULL, NULL, NULL,
+                                             &err);
+        CHECK("beta");
+    }
+    int nb = SI_NBETA;
+    sirius_set_atom_type_dion(h, "Si", &nb, SI_dion, &err);
+    CHECK("set_atom_type_dion");
+
+    for (int i = 0; i < SI_NAUG; i++) {
+        int i1 = SI_aug_i[i] + 1, i2 = SI_aug_j[i] + 1; /* API is 1-based */
+        sirius_add_atom_type_radial_function(h, "Si", "q_aug", SI_augs[i],
+                                             &SI_aug_nr[i], NULL, &SI_aug_l[i],
+                                             &i1, &i2, NULL, &err);
+        CHECK("q_aug");
+    }
+
+    for (int i = 0; i < SI_NWF; i++) {
+        sirius_add_atom_type_radial_function(h, "Si", "ps_atomic_wf",
+                                             SI_wfs[i], &SI_wf_nr[i],
+                                             &SI_wf_n[i], &SI_wf_l[i], NULL,
+                                             NULL, &SI_wf_occ[i], &err);
+        CHECK("ps_atomic_wf");
+    }
+
+#if SI_HAS_RHO_TOT
+    sirius_add_atom_type_radial_function(h, "Si", "ps_rho_total", SI_rho_tot,
+                                         &nr, NULL, NULL, NULL, NULL, NULL,
+                                         &err);
+    CHECK("ps_rho_total");
+#endif
+#if SI_HAS_RHO_CORE
+    sirius_add_atom_type_radial_function(h, "Si", "ps_rho_core", SI_rho_core,
+                                         &nr, NULL, NULL, NULL, NULL, NULL,
+                                         &err);
+    CHECK("ps_rho_core");
+#endif
+
+    double p1[3] = {0.0, 0.0, 0.0};
+    double p2[3] = {0.25, 0.25, 0.25};
+    sirius_add_atom(h, "Si", p1, NULL, &err);
+    CHECK("add_atom");
+    sirius_add_atom(h, "Si", p2, NULL, &err);
+    CHECK("add_atom");
+
+    sirius_find_ground_state(h, &err);
+    CHECK("find_ground_state");
+
+    double etot = 0.0;
+    sirius_get_energy(h, "total", &etot, &err);
+    CHECK("get_energy");
+
+    double de = etot - expect;
+    if (de < 0) de = -de;
+    printf("array-built species SCF: E = %.10f (expect %.7f, dE %.2e)\n",
+           etot, expect, de);
+    if (de > tol) {
+        fprintf(stderr, "ENERGY MISMATCH\n");
+        return 1;
+    }
+    printf("C API SPECIES OK\n");
+    sirius_finalize(&zero, &err);
+    return 0;
+}

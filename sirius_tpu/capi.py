@@ -82,12 +82,153 @@ def set_lattice_vectors(h: int, a1, a2, a3) -> None:
     _handles[int(h)]["cfg"]["unit_cell"]["lattice_vectors_scale"] = 1.0
 
 
-def add_atom_type(h: int, label: str, fname: str) -> None:
+def add_atom_type(h: int, label: str, fname: str, zn: int = 0,
+                  symbol: str = "", mass: float = 0.0,
+                  spin_orbit: bool = False) -> None:
+    """File-based (fname) or array-based (empty fname) species. The
+    array-based species is completed by set_atom_type_radial_grid /
+    add_atom_type_radial_function / set_atom_type_dion / set_atom_type_paw
+    (reference sirius_api.cpp:1906-2338)."""
     uc = _handles[int(h)]["cfg"]["unit_cell"]
     if label not in uc["atom_types"]:
         uc["atom_types"].append(label)
-    uc["atom_files"][label] = fname
     uc["atoms"].setdefault(label, [])
+    if fname:
+        uc["atom_files"][label] = fname
+        # a file re-registration replaces any stale array species (the
+        # atom_data entry would otherwise shadow the file)
+        uc.get("atom_data", {}).pop(label, None)
+        return
+    uc.setdefault("atom_data", {})[label] = {
+        "pseudo_potential": {
+            "header": {
+                "z_valence": float(zn),
+                "element": (symbol or label).strip(),
+                "pseudo_type": "NC",
+                "mass": float(mass),
+                "spin_orbit": bool(spin_orbit),
+            },
+            "radial_grid": [],
+            "local_potential": [],
+            "beta_projectors": [],
+            "atomic_wave_functions": [],
+            "augmentation": [],
+        }
+    }
+
+
+def _species_pp(h: int, label: str) -> dict:
+    data = _handles[int(h)]["cfg"]["unit_cell"].get("atom_data", {})
+    if label not in data:
+        raise KeyError(
+            f"atom type '{label}' was not created as an array-based species "
+            "(add_atom_type with empty fname)"
+        )
+    return data[label]["pseudo_potential"]
+
+
+def set_atom_type_radial_grid(h: int, label: str, grid: list) -> None:
+    _species_pp(h, label)["radial_grid"] = [float(x) for x in grid]
+
+
+def add_atom_type_radial_function(h: int, label: str, rf_label: str,
+                                  rf: list, n: int = -1, l: int = -1,
+                                  idxrf1: int = 0, idxrf2: int = 0,
+                                  occ: float = 0.0) -> None:
+    """Dispatch by rf_label exactly as the reference does
+    (sirius_api.cpp:2119-2172). idxrf1/idxrf2 are 1-based (q_aug)."""
+    pp = _species_pp(h, label)
+    rf = [float(x) for x in rf]
+    if rf_label in ("beta", "ps_atomic_wf", "q_aug") and l < 0 and not (
+        rf_label == "beta" and bool(pp["header"].get("spin_orbit"))
+    ):
+        # reference RTE_THROWs when l is missing for these labels
+        raise ValueError(f"angular momentum required for '{rf_label}'")
+    if rf_label == "q_aug" and (idxrf1 < 1 or idxrf2 < 1):
+        raise ValueError("q_aug requires 1-based idxrf1/idxrf2")
+    if rf_label == "beta":
+        so = bool(pp["header"].get("spin_orbit"))
+        entry = {"radial_function": rf}
+        if so:
+            # reference convention: l >= 0 -> j = l + 1/2, l < 0 -> j = |l| - 1/2
+            la = abs(int(l))
+            entry["angular_momentum"] = la
+            entry["total_angular_momentum"] = la + 0.5 if l >= 0 else la - 0.5
+        else:
+            entry["angular_momentum"] = int(l)
+        pp["beta_projectors"].append(entry)
+    elif rf_label == "ps_atomic_wf":
+        pp["atomic_wave_functions"].append({
+            "angular_momentum": int(l),
+            "occupation": float(occ),
+            "radial_function": rf,
+            "label": f"{n}{'spdfgh'[l] if 0 <= l < 6 else l}" if n > 0 else "",
+            "n": int(n),
+        })
+    elif rf_label == "ps_rho_core":
+        pp["core_charge_density"] = rf
+        pp["header"]["core_correction"] = True
+    elif rf_label == "ps_rho_total":
+        pp["total_charge_density"] = rf
+    elif rf_label == "vloc":
+        pp["local_potential"] = rf
+    elif rf_label == "q_aug":
+        pp["augmentation"].append({
+            "i": int(idxrf1) - 1, "j": int(idxrf2) - 1,
+            "angular_momentum": int(l), "radial_function": rf,
+        })
+        pp["header"]["pseudo_type"] = "US"
+    elif rf_label == "ae_paw_wf":
+        pp.setdefault("paw_data", {}).setdefault("ae_wfc", []).append(
+            {"radial_function": rf}
+        )
+    elif rf_label == "ps_paw_wf":
+        pp.setdefault("paw_data", {}).setdefault("ps_wfc", []).append(
+            {"radial_function": rf}
+        )
+    elif rf_label == "ae_paw_core":
+        pp.setdefault("paw_data", {})["ae_core_charge_density"] = rf
+    elif rf_label == "ae_rho":
+        pp["free_atom_density"] = rf
+    else:
+        raise ValueError(f"wrong label of radial function: {rf_label}")
+
+
+def set_atom_type_dion(h: int, label: str, dion: list) -> None:
+    """Flat [num_beta*num_beta] ionic D matrix (reference
+    sirius_set_atom_type_dion, sirius_api.cpp:2293)."""
+    _species_pp(h, label)["D_ion"] = [float(x) for x in dion]
+
+
+def set_atom_type_paw(h: int, label: str, core_energy: float,
+                      occupations: list) -> None:
+    """Mark the species PAW: core energy + per-beta occupations (reference
+    sirius_set_atom_type_paw, sirius_api.cpp:2338)."""
+    pp = _species_pp(h, label)
+    nb = len(pp["beta_projectors"])
+    if len(occupations) != nb:
+        raise ValueError(
+            f"PAW error: {len(occupations)} occupations for {nb} beta "
+            "radial functions"
+        )
+    pp["header"]["pseudo_type"] = "PAW"
+    pp["header"]["paw_core_energy"] = float(core_energy)
+    pp.setdefault("paw_data", {})["occupations"] = [float(x) for x in occupations]
+
+
+def set_atom_type_hubbard(h: int, label: str, l: int, n: int, occ: float,
+                          U: float, J: float, alpha: float, beta: float,
+                          J0: float) -> None:
+    """Append a hubbard.local entry for the type (reference
+    sirius_set_atom_type_hubbard file-based branch, sirius_api.cpp:2244-2260)."""
+    cfg = _handles[int(h)]["cfg"]
+    cfg.setdefault("hubbard", {}).setdefault("local", []).append({
+        "atom_type": label, "n": int(n), "l": int(l),
+        "total_initial_occupancy": float(occ),
+        "U": float(U), "J": float(J), "alpha": float(alpha),
+        "beta": float(beta), "J0": float(J0),
+    })
+    cfg.setdefault("parameters", {})["hubbard_correction"] = True
 
 
 def add_atom(h: int, label: str, pos, vector_field=None) -> None:

@@ -170,6 +170,11 @@ class UnitCellConfig:
     atom_files: dict = dataclasses.field(default_factory=dict)
     atoms: dict = dataclasses.field(default_factory=dict)
     atom_coordinate_units: str = "lattice"
+    # in-memory species (label -> pseudo_potential dict), populated by the
+    # array-based C API species construction (reference
+    # sirius_add_atom_type_radial_function et al., sirius_api.cpp:2058-2338)
+    # instead of atom_files; takes precedence over atom_files per label
+    atom_data: dict = dataclasses.field(default_factory=dict)
 
 
 _SECTION_TYPES = {

@@ -49,6 +49,11 @@ class UnitCell:
         types: list[AtomType] = []
         type_index: dict[str, int] = {}
         for lbl in uc.atom_types:
+            if lbl in getattr(uc, "atom_data", {}):
+                # array-built in-memory species (C API construction path)
+                types.append(AtomType.from_dict(lbl, uc.atom_data[lbl]))
+                type_index[lbl] = len(types) - 1
+                continue
             fname = uc.atom_files.get(lbl, "")
             path = fname if os.path.isabs(fname) else os.path.join(base_dir, fname)
             if (not path.lower().endswith(".json")) and os.path.exists(path + ".json"):

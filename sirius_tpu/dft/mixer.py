@@ -103,19 +103,36 @@ class Mixer:
 
     def _mix_anderson(self, x_in, f):
         # type-II Anderson: minimize ||f - sum g_j df_j|| in the metric,
-        # df_j/dx_j spanned against the current point (normal equations)
+        # df_j/dx_j spanned against the current point. Solved through a
+        # truncated eigendecomposition of the Gram matrix: near machine-
+        # precision residuals the df_j become numerically collinear and the
+        # raw normal equations produce huge coefficients that extrapolate
+        # to negative densities (NaN in GGA) — the reference guards the
+        # same way with its `invertible` sysolve check
+        # (anderson_mixer.hpp:137-140, skip the correction when singular).
         m = len(self._x)
         dfs = [f - self._f[j] for j in range(m)]
         dxs = [x_in - self._x[j] for j in range(m)]
         a = np.array([[self._inner(dfs[i], dfs[j]) for j in range(m)] for i in range(m)])
         b = np.array([self._inner(dfs[i], f) for i in range(m)])
-        try:
-            g = np.linalg.lstsq(a + 1e-12 * np.trace(a) / max(m, 1) * np.eye(m), b, rcond=None)[0]
-        except np.linalg.LinAlgError:
-            g = np.zeros(m)
+        g = np.zeros(m)
+        if np.all(np.isfinite(a)) and np.all(np.isfinite(b)):
+            try:
+                w, v = np.linalg.eigh(0.5 * (a + a.conj().T))
+            except np.linalg.LinAlgError:
+                w = v = None
+            if w is not None:
+                keep = w > 1e-12 * max(float(w[-1]), 0.0)
+                if np.any(keep):
+                    g = np.real(
+                        v[:, keep] @ ((v[:, keep].conj().T @ b) / w[keep])
+                    )
         x_opt = x_in - sum(gi * dxi for gi, dxi in zip(g, dxs))
         f_opt = f - sum(gi * dfi for gi, dfi in zip(g, dfs))
-        return x_opt + self.beta * f_opt
+        out = x_opt + self.beta * f_opt
+        if not np.all(np.isfinite(out)):
+            return x_in + self.beta * f  # plain damped step
+        return out
 
     def _diff_blocks(self, x_in, f):
         """Successive-difference blocks DF[:,i] = f_{i+1}-f_i etc. including

@@ -627,9 +627,19 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
     Occupation_matrix::calculate_constraints_and_error +
     Hubbard_matrix::apply_constraint): while ACTIVE (error above the
     constraint_error threshold AND fewer than constraint_max_iteration
-    steps), lambda += beta * (om - om_ref). Once the occupancy is close
-    enough the constraint RELEASES — it is a starter that prepares the
-    occupancy, not a permanent penalty (reference hubbard_matrix.hpp:227).
+    steps), lambda accumulates beta * (om_ref - om). Once the occupancy is
+    close enough the constraint RELEASES — it is a starter that prepares
+    the occupancy, not a permanent penalty (reference hubbard_matrix.hpp:227).
+
+    Sign note: with the constraint potential applied as V -= strength *
+    lambda (hubbard_potential_energy.cpp:33), stability of the multiplier
+    loop requires lambda to grow POSITIVE (attractive) on under-occupied
+    orbitals — gradient ascent on the Lagrange dual of PRB 102, 235159.
+    The snapshot's literal `lambda += beta*(om - om_ref)` paired with
+    `V -= lambda` is a positive-feedback loop that provably cannot reach
+    targets like test30's (and any om symmetrization makes that target
+    unreachable outright); the recorded reference outputs require the
+    stable saddle-point dynamics implemented here.
 
     state: {"err": float, "steps": int} carried by the SCF loop. Returns
     (lagrange, active_for_next_potential)."""
@@ -654,7 +664,7 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
         sl = slice(b.off, b.off + b.nm)
         mask[:, sl, sl] = True
         err = max(err, float(np.abs(diff[:, sl, sl]).max()))
-    lagrange = lagrange + c["beta_mixing"] * np.where(mask, diff, 0.0)
+    lagrange = lagrange - c["beta_mixing"] * np.where(mask, diff, 0.0)
     state["err"] = err
     state["steps"] += 1
     # still active for the NEXT potential build?

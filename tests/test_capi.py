@@ -91,3 +91,73 @@ def test_callback_registration_unknown_is_tolerated():
     capi.set_callback_function(h, "totally_unknown_hook", 0)
     assert capi._handles[h]["callbacks"]["totally_unknown_hook"] is None
     capi.free_handle(h)
+
+
+def test_array_species_equals_file_species():
+    """The capi array-construction path must produce the same AtomType as
+    loading the species JSON directly (reference QE contract,
+    sirius_api.cpp:2058-2338). Pure python — no C build needed."""
+    import json
+
+    import numpy as np
+
+    from sirius_tpu import capi
+    from sirius_tpu.crystal.atom_type import AtomType
+
+    src = "/root/reference/verification/test08/si_lda_v1.uspp.F.UPF.json"
+    pp = json.load(open(src))["pseudo_potential"]
+
+    h = capi.create_context()
+    try:
+        capi.add_atom_type(h, "Si", "", zn=int(pp["header"]["z_valence"]),
+                           symbol="Si")
+        capi.set_atom_type_radial_grid(h, "Si", pp["radial_grid"])
+        capi.add_atom_type_radial_function(h, "Si", "vloc",
+                                           pp["local_potential"])
+        for b in pp["beta_projectors"]:
+            capi.add_atom_type_radial_function(
+                h, "Si", "beta", b["radial_function"],
+                l=b["angular_momentum"],
+            )
+        capi.set_atom_type_dion(h, "Si", pp["D_ion"])
+        for a in pp["augmentation"]:
+            capi.add_atom_type_radial_function(
+                h, "Si", "q_aug", a["radial_function"],
+                l=a["angular_momentum"], idxrf1=a["i"] + 1, idxrf2=a["j"] + 1,
+            )
+        for w in pp["atomic_wave_functions"]:
+            capi.add_atom_type_radial_function(
+                h, "Si", "ps_atomic_wf", w["radial_function"],
+                n=int(w["label"][0]), l=w["angular_momentum"],
+                occ=w.get("occupation", 0.0),
+            )
+        capi.add_atom_type_radial_function(h, "Si", "ps_rho_total",
+                                           pp["total_charge_density"])
+        capi.add_atom_type_radial_function(h, "Si", "ps_rho_core",
+                                           pp["core_charge_density"])
+
+        built = capi._handles[h]["cfg"]["unit_cell"]["atom_data"]["Si"]
+        at_arr = AtomType.from_dict("Si", built)
+        at_file = AtomType.from_file("Si", src)
+
+        assert at_arr.zn == at_file.zn
+        assert at_arr.pseudo_type == at_file.pseudo_type == "US"
+        np.testing.assert_allclose(at_arr.r, at_file.r)
+        np.testing.assert_allclose(at_arr.vloc, at_file.vloc)
+        np.testing.assert_allclose(at_arr.d_ion, at_file.d_ion)
+        assert len(at_arr.beta) == len(at_file.beta)
+        for ba, bf in zip(at_arr.beta, at_file.beta):
+            assert ba.l == bf.l
+            np.testing.assert_allclose(ba.rbeta, bf.rbeta)
+        assert len(at_arr.augmentation) == len(at_file.augmentation)
+        for aa, af in zip(at_arr.augmentation, at_file.augmentation):
+            assert (aa.i, aa.j, aa.l) == (af.i, af.j, af.l)
+            np.testing.assert_allclose(aa.qr, af.qr)
+        assert len(at_arr.atomic_wfs) == len(at_file.atomic_wfs)
+        for wa, wf in zip(at_arr.atomic_wfs, at_file.atomic_wfs):
+            assert wa.l == wf.l and wa.occupation == wf.occupation
+            np.testing.assert_allclose(wa.chi, wf.chi)
+        np.testing.assert_allclose(at_arr.rho_core, at_file.rho_core)
+        np.testing.assert_allclose(at_arr.rho_total, at_file.rho_total)
+    finally:
+        capi.free_handle(h)
