@@ -33,6 +33,7 @@ from sirius_tpu.ops.atomic import atomic_orbitals
 from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
+from sirius_tpu.utils import checksums as _cks
 from sirius_tpu.utils.profiler import counters, profile, timer_report
 
 
@@ -540,6 +541,8 @@ def run_scf(
             counters["num_loc_op_applied"] += nk * ns * num_applies(
                 itsol.num_steps, nb
             )
+        if _cks.enabled():
+            _cks.checksum("evals", evals)
 
         # --- occupations ---
         mu, occ, entropy_sum = find_fermi(
@@ -628,6 +631,8 @@ def run_scf(
                 rho_spin[ispn] += rho_aug_g(ctx.unit_cell, ctx.gvec, ctx.aug, dm_blocks)
         rho_new = rho_spin.sum(axis=0)
         mag_new = rho_spin[0] - rho_spin[1] if polarized else None
+        if _cks.enabled():
+            _cks.checksum("rho_new", rho_new)
         if cfg.control.verification >= 1:
             # electron-count audit (reference Density::check_num_electrons,
             # dft_ground_state.cpp:305-308)
@@ -693,6 +698,8 @@ def run_scf(
         # --- potential + energies ---
         with profile("scf::potential"):
             pot = generate_potential(ctx, rho_g, xc, mag_g)
+        if _cks.enabled():
+            _cks.checksum("veff", pot.veff_g)
         scf_correction = (
             _epot(rho_new, mag_new, pot) - e1 if p.use_scf_correction else 0.0
         )

@@ -139,26 +139,52 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
                 chains[key] = radial_dme_chain(r, v_sph, l, e0, rel, max_m=need)
             comps.append(chains[key][be.dme])
         lo_enu.append(min(e_res))
-        if len(comps) != 2:
+        ncomp = len(comps)
+        if ncomp > 3:
             raise NotImplementedError(
-                f"lo with {len(comps)} radial components (2 supported)"
+                f"lo with {ncomp} radial components (1-3 supported)"
             )
-        (ua, hua, uaR, uapR), (ub, hub, ubR, ubpR) = comps
-        # zero-boundary combination WITHOUT division: (ca, cb) = (ubR, -uaR)
-        # gives f(R) = 0 exactly and stays stable when an auto enu lands on
-        # a bound state with u(R) -> 0
-        ca, cb = ubR, -uaR
-        if abs(ca) + abs(cb) < 1e-14:
-            ca, cb = 1.0, 0.0
-        f = ca * ua + cb * ub
-        hf = ca * hua + cb * hub
+        if ncomp == 2:
+            (ua, hua, uaR, uapR), (ub, hub, ubR, ubpR) = comps
+            # zero-boundary combination WITHOUT division: (ca, cb) =
+            # (ubR, -uaR) gives f(R) = 0 exactly and stays stable when an
+            # auto enu lands on a bound state with u(R) -> 0
+            cvec = np.array([ubR, -uaR])
+            if np.abs(cvec).sum() < 1e-14:
+                cvec = np.array([1.0, 0.0])
+        elif ncomp == 1:
+            cvec = np.array([1.0])
+        else:
+            # n-component lo (reference generate_lo_radial_functions,
+            # atom_symmetry_class.cpp:206-226): surface derivatives up to
+            # order n-2 vanish, the (n-1)-th is pinned to 1 —
+            # A[i][j] = d^i u_j/dr^i |_R, solve A c = e_{n-1}
+            def surf_d2(u):
+                k = 7  # local cubic fit near the boundary
+                c = np.polyfit(r[-k:] - r[-1], u[-k:], 3)
+                return 2.0 * c[1]
+
+            A = np.zeros((3, 3))
+            for j, (uj, _, uRj, upRj) in enumerate(comps):
+                A[0, j] = uRj
+                A[1, j] = upRj
+                A[2, j] = surf_d2(uj)
+            rhs = np.array([0.0, 0.0, 1.0])
+            try:
+                cvec = np.linalg.solve(A, rhs)
+            except np.linalg.LinAlgError:
+                # degenerate surface matrix: drop the last component
+                cvec = np.zeros(3)
+                cvec[:2] = [comps[1][2], -comps[0][2]]
+                if np.abs(cvec).sum() < 1e-14:
+                    cvec = np.array([1.0, 0.0, 0.0])
+        f = sum(c * u for c, (u, _, _, _) in zip(cvec, comps))
+        hf = sum(c * hu for c, (_, hu, _, _) in zip(cvec, comps))
+        fR = sum(c * uR for c, (_, _, uR, _) in zip(cvec, comps))
+        fpR = sum(c * upR for c, (_, _, _, upR) in zip(cvec, comps))
         nrm = np.sqrt(rint(f * f * r * r, r))
         lo.append(
-            MtRadial(
-                l=l, f=f / nrm, hf=hf / nrm,
-                fR=(ca * uaR + cb * ubR) / nrm,
-                fpR=(ca * uapR + cb * ubpR) / nrm,
-            )
+            MtRadial(l=l, f=f / nrm, hf=hf / nrm, fR=fR / nrm, fpR=fpR / nrm)
         )
     minv_R = 1.0
     # ZORA/IORA only: their interstitial kinetic carries the matching

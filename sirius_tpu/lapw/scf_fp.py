@@ -747,6 +747,21 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             if len(etot_history) > 1
             else np.inf
         )
+        if cfg.control.verbosity >= 2:
+            print(
+                f"[scf_fp] it={it + 1:3d} etot={e_total:+.10f} "
+                f"rms={rms:.3e} de={de:.3e}",
+                flush=True,
+            )
+        if cfg.control.verbosity >= 3:
+            nig = 2 * len(rho_ig)  # rho_ig packs as .view(float)
+            d_ig = x_out[:nig] - x_in[:nig]
+            d_mt = x_out[nig:] - x_in[nig:]
+            print(
+                f"[scf_fp]   rms_ig={float(np.sqrt(np.mean(np.abs(d_ig)**2))):.3e}"
+                f" rms_mt={float(np.sqrt(np.mean(np.abs(d_mt)**2))):.3e}",
+                flush=True,
+            )
         if rms < p.density_tol and de < p.energy_tol:
             converged = True
             rho_ig, rho_mt = rho_ig_new, rho_mt_new

@@ -43,8 +43,8 @@ WIRED = [
     "test14",  # SrVO3 US PBE
     "test15",  # LiF PAW LDA Gamma
     "test16",  # NiO FP-LAPW LSDA AFM
-    "test17",  # Si FP-LAPW PBE
-    "test18",  # YN FP-LAPW IORA
+    "test17",  # NiO FP-LAPW PBE (nonmagnetic)
+    "test18",  # YN FP-LAPW IORA (3-component lo)
     "test19",  # Fe bcc FP-LAPW collinear LDA-PW 4x4x4
     "test20",  # H2O FP-LAPW molecule LDA-VWN
     "test21",  # FeSi US PBE collinear Fermi-Dirac
