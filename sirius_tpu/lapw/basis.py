@@ -145,11 +145,10 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
                 f"lo with {ncomp} radial components (1-3 supported)"
             )
         if ncomp == 2:
-            (ua, hua, uaR, uapR), (ub, hub, ubR, ubpR) = comps
             # zero-boundary combination WITHOUT division: (ca, cb) =
-            # (ubR, -uaR) gives f(R) = 0 exactly and stays stable when an
+            # (u1R, -u0R) gives f(R) = 0 exactly and stays stable when an
             # auto enu lands on a bound state with u(R) -> 0
-            cvec = np.array([ubR, -uaR])
+            cvec = np.array([comps[1][2], -comps[0][2]])
             if np.abs(cvec).sum() < 1e-14:
                 cvec = np.array([1.0, 0.0])
         elif ncomp == 1:

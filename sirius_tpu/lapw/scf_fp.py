@@ -640,10 +640,14 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 C_k, ctx.gkmill, occ_np[:, 0, :], ctx.kweights, ctx.dims,
                 ctx.omega,
             )
-        # spread the core spill-out uniformly over the interstitial
-        # (reference density.cpp: core leakage -> constant background)
-        vol_i = ctx.istl_integral(np.ones(ctx.dims), np.ones(ctx.dims))
-        rho_r_new += core_leak / vol_i
+        # Core spill-out is NOT compensated during the SCF: the reference
+        # adds the core density only inside the MT (density.cpp:1112-1121)
+        # and renormalizes the initial density only (normalize() called
+        # from initial_density alone) — the leaked charge is simply absent
+        # from the SCF density. Spreading it as a uniform interstitial
+        # background (our previous behavior) shifts the Hartree potential
+        # by a near-uniform delta that leaks into every energy term via
+        # the core states (the test19-class uniform MT offset).
         rho_ig_new = np.fft.fftn(rho_r_new).ravel()[ctx.gvec.fft_index] / n
         if nm:
             mag_ig_new = np.fft.fftn(mag_r_new).ravel()[ctx.gvec.fft_index] / n
@@ -754,7 +758,8 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 flush=True,
             )
         if cfg.control.verbosity >= 3:
-            nig = 2 * len(rho_ig)  # rho_ig packs as .view(float)
+            # pack layout: [rho_ig, mag_ig?] (as float views) then MT blocks
+            nig = 2 * len(rho_ig) * (2 if nm else 1)
             d_ig = x_out[:nig] - x_in[:nig]
             d_mt = x_out[nig:] - x_in[nig:]
             print(
