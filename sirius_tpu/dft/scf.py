@@ -859,6 +859,7 @@ def run_scf(
             rho_real_space(ctx, mag_g) if polarized else None,
             psi, occ_np, evals, d_by_spin,
             dm_blocks_by_spin=dm_blocks_by_spin if ctx.aug is not None else None,
+            hub=hub,
         )
         result["stress"] = sterms["total"].tolist()
     if save_to:

@@ -79,6 +79,15 @@ class StressCalculator:
         from sirius_tpu.ops.beta import beta_radial_table
 
         self.beta_tab = [beta_radial_table(t, qmax_gk) for t in uc.atom_types]
+        from sirius_tpu.core.radial import RadialIntegralTable
+
+        self.ao_tab = [
+            RadialIntegralTable.build(
+                t.r, np.stack([w.chi for w in t.atomic_wfs]),
+                np.array([w.l for w in t.atomic_wfs]), qmax_gk, m=1,
+            ) if t.atomic_wfs else None
+            for t in uc.atom_types
+        ]
         if ctx.aug is not None:
             from sirius_tpu.ops.augmentation import aug_radial_tables
 
@@ -242,10 +251,36 @@ class StressCalculator:
                 )
         return float(e.sum()) * om / n
 
+    def _beta_k(self, ik, qlen, rlm, pref):
+        """Strained beta-projector table for one k (shared by the nonloc
+        and hubbard stress terms — ONE copy of the phase/prefactor
+        convention pref * (-i)^l * R_lm * RI(q) * e^{-iG.r})."""
+        from sirius_tpu.core.sht import lm_index
+
+        ctx = self.ctx
+        uc = ctx.unit_cell
+        ngk = int(ctx.gkvec.num_gk[ik])
+        beta_k = np.zeros((ctx.beta.num_beta_total, ngk), dtype=np.complex128)
+        mk = ctx.gkvec.millers[ik, :ngk] + ctx.gkvec.kpoints[ik][None, :]
+        for ia, off, nbf in ctx.beta.atom_blocks(uc):
+            t = uc.atom_types[uc.type_of_atom[ia]]
+            if not t.num_beta:
+                continue
+            ri = self.beta_tab[uc.type_of_atom[ia]](qlen[ik, :ngk])
+            phase = np.exp(-2j * np.pi * (mk @ uc.positions[ia]))
+            idxrf, ls, ms = t.beta_lm_table()
+            for xi in range(nbf):
+                l, m_, ir = int(ls[xi]), int(ms[xi]), int(idxrf[xi])
+                beta_k[off + xi] = (
+                    pref * (-1j) ** l * rlm[ik, :ngk, lm_index(l, m_)]
+                    * ri[ir] * phase
+                )
+        return beta_k
+
     def e_nonloc(self, eps, psi, occ_w, evals, d_by_spin):
         """Non-local energy with strained projector tables; includes the
         -eps <psi|Q|psi> orthogonality term for ultrasoft."""
-        from sirius_tpu.core.sht import lm_index, ylm_real
+        from sirius_tpu.core.sht import ylm_real
 
         ctx = self.ctx
         uc = ctx.unit_cell
@@ -264,20 +299,7 @@ class StressCalculator:
         nk = ctx.gkvec.num_kpoints
         for ik in range(nk):
             ngk = int(ctx.gkvec.num_gk[ik])
-            beta_k = np.zeros((ctx.beta.num_beta_total, ngk), dtype=np.complex128)
-            mk = ctx.gkvec.millers[ik, :ngk] + ctx.gkvec.kpoints[ik][None, :]
-            for ia, off, nbf in ctx.beta.atom_blocks(uc):
-                t = uc.atom_types[uc.type_of_atom[ia]]
-                if not t.num_beta:
-                    continue
-                ri = self.beta_tab[uc.type_of_atom[ia]](qlen[ik, :ngk])
-                phase = np.exp(-2j * np.pi * (mk @ uc.positions[ia]))
-                idxrf, ls, ms = t.beta_lm_table()
-                for xi in range(nbf):
-                    l, m_, ir = int(ls[xi]), int(ms[xi]), int(idxrf[xi])
-                    beta_k[off + xi] = (
-                        pref * (-1j) ** l * rlm[ik, :ngk, lm_index(l, m_)] * ri[ir] * phase
-                    )
+            beta_k = self._beta_k(ik, qlen, rlm, pref)
             for ispn in range(psi.shape[1]):
                 ps = np.asarray(psi[ik, ispn])[:, :ngk]
                 bp = np.conj(beta_k) @ ps.T  # (nbeta, nb)
@@ -289,13 +311,131 @@ class StressCalculator:
                     e -= float(np.sum(f * evals[ik, ispn] * o))
         return e
 
+    def _hub_om_eps(self, eps, psi, occ_w, hub):
+        """(om_sym, om_nl) from STRAINED hubbard orbitals at frozen psi —
+        the occupancy response the reference computes analytically in
+        compute_occupancies_stress_derivatives
+        (hubbard_occupancies_derivatives.cpp); here the same derivative is
+        taken by central differences of the exact strained occupancy."""
+        from sirius_tpu.core.sht import lm_index, ylm_real
+        from sirius_tpu.ops.hubbard import (
+            nonlocal_from_occ_T,
+            symmetrize_occupation,
+        )
+
+        ctx = self.ctx
+        uc = ctx.unit_cell
+        gk = self._gkcart(eps)
+        qlen = np.linalg.norm(gk, axis=-1)
+        lmax_ao = max(
+            (w.l for t in uc.atom_types for w in t.atomic_wfs), default=0
+        )
+        lmax_b = max(
+            (t.lmax_beta for t in uc.atom_types if t.num_beta), default=0
+        )
+        rhat = np.where(
+            qlen[..., None] > 1e-30,
+            gk / np.maximum(qlen, 1e-30)[..., None], np.array([0.0, 0, 1.0]),
+        )
+        rlm = ylm_real(max(lmax_ao, lmax_b), rhat)
+        pref = 4.0 * np.pi / np.sqrt(self._omega(eps))
+        qmat = ctx.beta.qmat
+        nk = ctx.gkvec.num_kpoints
+        ns = psi.shape[1]
+        nh = hub.num_hub_total
+        ao_off = []
+        off = 0
+        for ia in range(uc.num_atoms):
+            ao_off.append(off)
+            off += uc.atom_types[uc.type_of_atom[ia]].num_atomic_wf_lm
+        nao = off
+        om = np.zeros((ns, nh, nh), dtype=np.complex128)
+        occ_T = {t: np.zeros((ns, nh, nh), dtype=np.complex128) for t in hub.trans}
+        for ik in range(nk):
+            ngk = int(ctx.gkvec.num_gk[ik])
+            mk = ctx.gkvec.millers[ik, :ngk] + ctx.gkvec.kpoints[ik][None, :]
+            # strained atomic orbitals, whole cell
+            phi = np.zeros((nao, ngk), dtype=np.complex128)
+            for ia in range(uc.num_atoms):
+                it = uc.type_of_atom[ia]
+                t = uc.atom_types[it]
+                if not t.atomic_wfs:
+                    continue
+                ri = self.ao_tab[it](qlen[ik, :ngk])
+                phase = np.exp(-2j * np.pi * (mk @ uc.positions[ia]))
+                xi = 0
+                for iw, w in enumerate(t.atomic_wfs):
+                    for m in range(-w.l, w.l + 1):
+                        phi[ao_off[ia] + xi] = (
+                            pref * (-1j) ** w.l
+                            * rlm[ik, :ngk, lm_index(w.l, m)]
+                            * ri[iw] * phase
+                        )
+                        xi += 1
+            # strained beta for S phi (shared helper with e_nonloc)
+            if qmat is not None and ctx.beta.num_beta_total:
+                beta_k = self._beta_k(ik, qlen, rlm, pref)
+
+                def s_apply(p):
+                    bp = np.conj(beta_k) @ p.T
+                    return p + (beta_k.T @ (qmat @ bp)).T
+            else:
+                s_apply = lambda p: p
+            if hub.full_ortho:
+                sphi = s_apply(phi)
+                o = np.conj(phi) @ sphi.T
+                s, u = np.linalg.eigh(0.5 * (o + o.conj().T))
+                s = np.maximum(s, 1e-12)
+                binv = (u * (1.0 / np.sqrt(s))[None, :]) @ u.conj().T
+                phi = binv.T @ phi
+            sphi = s_apply(phi)
+            # block rows -> hubbard ordering
+            phi_s = np.zeros((nh, ngk), dtype=np.complex128)
+            for b in hub.blocks:
+                t = uc.atom_types[uc.type_of_atom[b.ia]]
+                src = ao_off[b.ia] + sum(
+                    2 * t.atomic_wfs[i].l + 1 for i in range(b.iw)
+                )
+                phi_s[b.off : b.off + b.nm] = sphi[src : src + b.nm]
+            k = ctx.gkvec.kpoints[ik]
+            for ispn in range(ns):
+                hp = np.conj(phi_s) @ np.asarray(psi[ik, ispn])[:, :ngk].T
+                f = occ_w[ik, ispn] / ctx.max_occupancy
+                o_k = np.einsum("mb,b,nb->mn", hp, f, np.conj(hp))
+                om[ispn] += o_k
+                for t_, acc in occ_T.items():
+                    acc[ispn] += o_k * np.exp(
+                        -2j * np.pi * float(np.dot(k, t_))
+                    )
+        if ctx.symmetry is not None and ctx.symmetry.num_ops > 1 and hub.sym_maps:
+            om, om_nl = symmetrize_occupation(ctx, hub, om, occ_T)
+        else:
+            om_nl = nonlocal_from_occ_T(hub, occ_T) if hub.nonloc else []
+        return om, om_nl
+
+    def e_hubbard(self, eps, psi, occ_w, hub, um_local, um_nl):
+        """Re_sum V_frozen . om(eps): its strain derivative is the
+        reference's sigma_hub = sum V . dn/deps (stress.cpp:152-190)."""
+        om, om_nl = self._hub_om_eps(eps, psi, occ_w, hub)
+        e = sum(
+            float(np.real(np.sum(om[ispn] * np.conj(um_local[ispn]))))
+            for ispn in range(om.shape[0])
+        )
+        e += sum(
+            float(np.real(np.sum(o * np.conj(u))))
+            for o, u in zip(om_nl, um_nl)
+        )
+        return self.ctx.max_occupancy * e
+
     # --- assembly -------------------------------------------------------
     def compute(
         self, rho_g, mag_g, rho_r, mag_r, psi, occ, evals, d_by_spin,
-        dm_blocks_by_spin=None,
+        dm_blocks_by_spin=None, hub=None,
     ) -> dict:
         """dm_blocks_by_spin: per-spin list of per-atom density-matrix
-        blocks (required for the augmentation stress of US/PAW species)."""
+        blocks (required for the augmentation stress of US/PAW species).
+        hub: HubbardData — adds the sigma_hub term (reference
+        calc_stress_hubbard)."""
         ctx = self.ctx
         self._rho_g_ref = rho_g
         self._mag_g_ref = mag_g
@@ -325,6 +465,16 @@ class StressCalculator:
             "xc": lambda e: self.e_xc(e),
             "nonloc": lambda e: self.e_nonloc(e, psi, occ_w, evals, d_by_spin),
         }
+        if hub is not None:
+            from sirius_tpu.ops.hubbard import hubbard_potential_and_energy
+
+            om0, om_nl0 = self._hub_om_eps(np.zeros((3, 3)), psi, occ_w, hub)
+            um_local, um_nl, _, _ = hubbard_potential_and_energy(
+                hub, om0, ctx.max_occupancy, om_nl=om_nl0,
+            )
+            terms["hubbard"] = lambda e: self.e_hubbard(
+                e, psi, occ_w, hub, um_local, um_nl
+            )
         out = {"kin": self.sigma_kinetic(psi, occ_w)}
         om = ctx.unit_cell.omega
         h = self.h

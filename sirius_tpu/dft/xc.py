@@ -63,16 +63,26 @@ def _pw92_g(rs: jnp.ndarray, a, a1, b1, b2, b3, b4) -> jnp.ndarray:
     return -2.0 * a * (1 + a1 * rs) * jnp.log1p(1.0 / den)
 
 
-def _lda_c_pw_e(nu: jnp.ndarray, nd: jnp.ndarray) -> jnp.ndarray:
-    """Perdew-Wang 92 correlation, full spin interpolation."""
+def _lda_c_pw_e(nu: jnp.ndarray, nd: jnp.ndarray, mod: bool = False) -> jnp.ndarray:
+    """Perdew-Wang 92 correlation, full spin interpolation.
+
+    mod=True selects the PW_MOD constants (libxc lda_c_pw_mod: one more
+    digit on the A coefficients) — the parametrization PBE correlation is
+    DEFINED on. libxc's XC_GGA_C_PBE builds on pw_mod, XC_LDA_C_PW on the
+    published PW92 digits; the ~1e-5-relative difference in eps_c is a
+    reproducible 1e-5 Ha-class shift on PBE deck totals."""
     n = nu + nd
     zeta = jnp.clip((nu - nd) / n, -1.0, 1.0)
     rs = (3.0 / (4.0 * jnp.pi * n)) ** (1.0 / 3.0)
-    ec0 = _pw92_g(rs, 0.031091, 0.21370, 7.5957, 3.5876, 1.6382, 0.49294)
-    ec1 = _pw92_g(rs, 0.015545, 0.20548, 14.1189, 6.1977, 3.3662, 0.62517)
+    a0, a1, a2 = (
+        (0.0310907, 0.01554535, 0.0168869) if mod
+        else (0.031091, 0.015545, 0.016887)
+    )
+    ec0 = _pw92_g(rs, a0, 0.21370, 7.5957, 3.5876, 1.6382, 0.49294)
+    ec1 = _pw92_g(rs, a1, 0.20548, 14.1189, 6.1977, 3.3662, 0.62517)
     # alpha_c(rs) = -G(fit): the PW92 spin-stiffness fit parametrizes -alpha_c,
     # so mac (= alpha_c) enters the interpolation with a POSITIVE sign.
-    mac = -_pw92_g(rs, 0.016887, 0.11125, 10.357, 3.6231, 0.88026, 0.49671)
+    mac = -_pw92_g(rs, a2, 0.11125, 10.357, 3.6231, 0.88026, 0.49671)
     fz = _zeta_f(zeta)
     fpp0 = 8.0 / (9.0 * (2.0 ** (4.0 / 3.0) - 2.0))
     z4 = zeta**4
@@ -141,7 +151,7 @@ def _pbe_c_e(nu, nd, suu, sud, sdd, beta: float = _PBE_BETA) -> jnp.ndarray:
     n = nu + nd
     zeta = jnp.clip((nu - nd) / n, -1.0, 1.0)
     sigma = suu + 2 * sud + sdd
-    eps_lda = _lda_c_pw_e(nu, nd) / n
+    eps_lda = _lda_c_pw_e(nu, nd, mod=True) / n  # libxc: PBE is on pw_mod
     phi = 0.5 * ((1 + zeta) ** (2.0 / 3.0) + (1 - zeta) ** (2.0 / 3.0))
     kf = (3.0 * jnp.pi**2 * n) ** (1.0 / 3.0)
     ks = jnp.sqrt(4.0 * kf / jnp.pi)

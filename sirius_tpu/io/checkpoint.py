@@ -34,6 +34,14 @@ def save_state(
         meta.create_dataset("millers", data=ctx.gvec.millers)
         meta.create_dataset("lattice", data=ctx.unit_cell.lattice)
         meta.attrs["num_gvec"] = ctx.gvec.num_gvec
+        meta.attrs["pw_cutoff"] = float(ctx.cfg.parameters.pw_cutoff)
+        meta.attrs["gk_cutoff"] = float(ctx.cfg.parameters.gk_cutoff)
+        # per-k G+k sphere indices: lets load_state remap wave functions
+        # onto a slightly different G-set (restart across small lattice
+        # changes — variable-cell relaxation, stress FD seeding)
+        meta.create_dataset("gk_millers", data=ctx.gkvec.millers)
+        meta.create_dataset("num_gk", data=np.asarray(ctx.gkvec.num_gk))
+        meta.create_dataset("kpoints", data=np.asarray(ctx.gkvec.kpoints))
         den = f.create_group("density")
         den.create_dataset("rho_g", data=np.asarray(rho_g))
         if mag_g is not None:
@@ -60,26 +68,107 @@ def load_state(path: str, ctx) -> dict:
     out: dict = {}
     with h5py.File(path, "r") as f:
         mill = f["meta/millers"][...]
-        if mill.shape != ctx.gvec.millers.shape or not np.array_equal(
+        exact = mill.shape == ctx.gvec.millers.shape and np.array_equal(
             mill, ctx.gvec.millers
-        ):
-            raise ValueError(
-                "checkpoint G-set does not match the current context "
-                "(different cutoff/lattice)"
+        )
+        lat_ok = np.allclose(
+            f["meta/lattice"][...], ctx.unit_cell.lattice, atol=1e-10
+        )
+        g_map = None
+        gk_maps = None
+        if exact and not lat_ok:
+            # same G enumeration under a small lattice change (hydrostatic
+            # strain preserves the ordering): accept as-is, no remap needed
+            lat_scale = float(np.abs(ctx.unit_cell.lattice).max())
+            if (
+                np.abs(f["meta/lattice"][...] - ctx.unit_cell.lattice).max()
+                > 0.05 * lat_scale
+            ):
+                raise ValueError("checkpoint lattice does not match")
+        elif not exact:
+            # remap by Miller index: restart across a small lattice change
+            # (variable-cell relaxation step, strained-lattice seeding);
+            # G vectors leaving the sphere are dropped, entering ones -> 0.
+            # Requires the SAME cutoffs — a different G-set by cutoff is a
+            # user error and still refuses.
+            lat_saved = f["meta/lattice"][...]
+            lat_scale = float(np.abs(ctx.unit_cell.lattice).max())
+            cut_ok = (
+                "pw_cutoff" in f["meta"].attrs
+                and float(f["meta"].attrs["pw_cutoff"])
+                == float(ctx.cfg.parameters.pw_cutoff)
+                and float(f["meta"].attrs["gk_cutoff"])
+                == float(ctx.cfg.parameters.gk_cutoff)
             )
-        if not np.allclose(f["meta/lattice"][...], ctx.unit_cell.lattice, atol=1e-10):
-            raise ValueError("checkpoint lattice does not match")
-        out["rho_g"] = f["density/rho_g"][...]
+            if (
+                not cut_ok
+                or np.abs(lat_saved - ctx.unit_cell.lattice).max()
+                > 0.05 * lat_scale
+            ):
+                raise ValueError(
+                    "checkpoint G-set does not match the current context "
+                    "(different cutoff or a large lattice change)"
+                )
+            saved = {tuple(m): i for i, m in enumerate(mill)}
+            g_map = np.array(
+                [saved.get(tuple(m), -1) for m in ctx.gvec.millers],
+                dtype=np.int64,
+            )
+            # psi remap needs the SAME k-point list (index-paired): a
+            # changed IBZ (symmetry broken by the strain) silently drops
+            # psi from the restart rather than scattering coefficients
+            # onto wrong k spheres
+            k_same = (
+                "kpoints" in f["meta"]
+                and f["meta/kpoints"].shape == ctx.gkvec.kpoints.shape
+                and np.allclose(
+                    f["meta/kpoints"][...], ctx.gkvec.kpoints, atol=1e-10
+                )
+            )
+            if "gk_millers" in f["meta"] and k_same:
+                gk_mill = f["meta/gk_millers"][...]
+                gk_num = f["meta/num_gk"][...]
+                gk_maps = []
+                for ik in range(ctx.gkvec.num_kpoints):
+                    sk = {
+                        tuple(m): i
+                        for i, m in enumerate(gk_mill[ik][: int(gk_num[ik])])
+                    }
+                    nk_now = int(ctx.gkvec.num_gk[ik])
+                    gk_maps.append(np.array(
+                        [sk.get(tuple(m), -1)
+                         for m in ctx.gkvec.millers[ik][:nk_now]],
+                        dtype=np.int64,
+                    ))
+
+        def remap_g(a):
+            if g_map is None:
+                return a
+            o = np.zeros(a.shape[:-1] + (len(g_map),), dtype=a.dtype)
+            ok = g_map >= 0
+            o[..., ok] = a[..., g_map[ok]]
+            return o
+
+        out["rho_g"] = remap_g(f["density/rho_g"][...])
         if "mag_g" in f["density"]:
-            out["mag_g"] = f["density/mag_g"][...]
+            out["mag_g"] = remap_g(f["density/mag_g"][...])
         if "paw_dm" in f["density"]:
             out["paw_dm"] = f["density/paw_dm"][...]
         if "potential" in f:
-            out["veff_g"] = f["potential/veff_g"][...]
+            out["veff_g"] = remap_g(f["potential/veff_g"][...])
             if "bz_g" in f["potential"]:
-                out["bz_g"] = f["potential/bz_g"][...]
-        if "kset" in f:
-            out["psi"] = f["kset/psi"][...]
+                out["bz_g"] = remap_g(f["potential/bz_g"][...])
+        if "kset" in f and (g_map is None or gk_maps is not None):
+            psi = f["kset/psi"][...]
+            if gk_maps is not None:
+                new = np.zeros(
+                    psi.shape[:-1] + (ctx.gkvec.ngk_max,), dtype=psi.dtype
+                )
+                for ik, mp in enumerate(gk_maps):
+                    idx = np.nonzero(mp >= 0)[0]
+                    new[ik][..., idx] = psi[ik][..., mp[idx]]
+                psi = new
+            out["psi"] = psi
             for k in ("band_energies", "band_occupancies"):
                 if k in f["kset"]:
                     out[k] = f["kset"][k][...]

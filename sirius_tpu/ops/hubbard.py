@@ -48,6 +48,7 @@ class HubBlock:
     occupancy: float = 0.0
     initial_occupancy: list | None = None
     hmat: np.ndarray | None = None  # [nm,nm,nm,nm] full-U Coulomb matrix
+    iw: int = 0  # atomic-wf index within the species (stress rebuilds)
 
 
 @dataclasses.dataclass
@@ -65,6 +66,7 @@ class HubbardData:
     sym_maps: list | None = None  # per op: (inv_perm, inv_T[nat,3])
     sym_ops: list | None = None  # the ctx symmetry ops (rot_cart used)
     constraint: dict | None = None
+    full_ortho: bool = False  # O^{-1/2} over the whole atomic-wf subspace
 
     # ---------------- legacy compat: iterate (ia, off, nm, Ueff, alpha, l)
     @property
@@ -130,7 +132,8 @@ class HubbardData:
         for ia in range(uc.num_atoms):
             it = uc.type_of_atom[ia]
             for (iw, n, l, e) in type_orbitals[it]:
-                b = HubBlock(ia=ia, off=nhub, nm=2 * l + 1, l=l, n=n, use=e is not None)
+                b = HubBlock(ia=ia, off=nhub, nm=2 * l + 1, l=l, n=n,
+                             use=e is not None, iw=iw)
                 if e is not None:
                     b.U = float(e.get("U", 0.0))
                     b.J = float(e.get("J", 0.0))
@@ -241,6 +244,7 @@ class HubbardData:
             phi_gk=phi_b,
             simplified=bool(cfg.hubbard.simplified), nonloc=nonloc,
             trans=sorted(trans_keys), sym_maps=sym_maps, constraint=cons,
+            full_ortho=full_ortho,
         )
 
 

@@ -111,3 +111,68 @@ def test_stress_ultrasoft_matches_finite_difference():
         fm = _run_us(-eps)[0]["energy"]["free"]
         fd = (fp - fm) / (2 * h) / 2.0 / omega0
         np.testing.assert_allclose(sigma[a, b], fd, atol=4e-6, err_msg=f"{(a,b)}")
+
+
+def _run_hub(strain=None, restart_from=None, save_to=None):
+    import sirius_tpu.crystal.unit_cell as ucm
+
+    from sirius_tpu.dft.scf import run_scf
+
+    # gk inside a G-shell gap (see _run_us) so the FD ground truth is smooth
+    ctx = synthetic_silicon_context(
+        gk_cutoff=3.09,
+        pw_cutoff=7.0,
+        ngridk=(1, 1, 1),
+        num_bands=8,
+        ultrasoft=True,
+        use_symmetry=False,
+        positions=np.array([[0.0, 0, 0], [0.26, 0.24, 0.25]]),
+        extra_params={"density_tol": 3e-7, "energy_tol": 1e-6,
+                      "num_dft_iter": 150, "hubbard_correction": True},
+    )
+    ctx.cfg.hubbard.local = [
+        {"atom_type": ctx.unit_cell.atom_types[0].label, "l": 1, "n": 2,
+         "U": 0.08, "total_initial_occupancy": 2}
+    ]
+    ctx.cfg.hubbard.simplified = True
+    if strain is not None:
+        uc = ctx.unit_cell
+        lat = uc.lattice @ (np.eye(3) + strain).T
+        uc2 = ucm.UnitCell(
+            lattice=lat, atom_types=uc.atom_types, type_of_atom=uc.type_of_atom,
+            positions=uc.positions, moments=uc.moments,
+        )
+        import sirius_tpu.context as cm
+
+        orig = ucm.UnitCell.from_config
+        try:
+            ucm.UnitCell.from_config = staticmethod(lambda c, b=".": uc2)
+            ctx = cm.SimulationContext.create(ctx.cfg, ".")
+        finally:
+            ucm.UnitCell.from_config = orig
+    ctx.cfg.control.print_stress = strain is None
+    return (
+        run_scf(ctx.cfg, ctx=ctx, restart_from=restart_from, save_to=save_to),
+        ctx.unit_cell.omega,
+    )
+
+
+def test_stress_hubbard_matches_finite_difference(tmp_path):
+    """sigma_hub (reference calc_stress_hubbard, stress.cpp:103-198) via
+    strained hubbard orbitals: total stress of a +U ultrasoft cell must
+    match full-SCF strained-lattice finite differences. The strained SCFs
+    restart from the unstrained state — the +U functional has several SCF
+    basins on this synthetic cell and an FD across basins is meaningless."""
+    ck = str(tmp_path / "hub_stress_state")
+    res, omega0 = _run_hub(save_to=ck)
+    assert res["converged"]
+    sigma = np.asarray(res["stress"])
+    h = 1e-4
+    for (a, b) in [(0, 0), (0, 1)]:
+        eps = np.zeros((3, 3))
+        eps[a, b] += h
+        eps[b, a] += h
+        fp = _run_hub(eps, restart_from=ck)[0]["energy"]["free"]
+        fm = _run_hub(-eps, restart_from=ck)[0]["energy"]["free"]
+        fd = (fp - fm) / (2 * h) / 2.0 / omega0
+        np.testing.assert_allclose(sigma[a, b], fd, atol=4e-6, err_msg=f"{(a,b)}")
