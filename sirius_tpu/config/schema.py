@@ -38,6 +38,13 @@ class ControlConfig:
     ortho_rf: bool = False
     save_rf: bool = False
     use_second_variation: bool = True
+    # G-sharded band solve (slab FFT over the "g" mesh axis): "auto"
+    # switches when the replicated projector+wave-function footprint
+    # exceeds gshard_budget_bytes per device; True forces, False disables.
+    # sirius_tpu extension (no reference analog: the reference distributes
+    # G vectors via its MPI fft_mode="parallel" instead)
+    gshard: object = "auto"
+    gshard_budget_bytes: float = 2.0e9
 
 
 @dataclasses.dataclass

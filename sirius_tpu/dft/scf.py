@@ -399,6 +399,70 @@ def run_scf(
 
         def _place_psi(x):
             return x
+
+    # ---- G-sharded band solve (slab FFT over a "g" mesh): selected when
+    # the replicated projector + wave-function footprint would not fit a
+    # single device (cfg.control.gshard "auto"/True). Single-k no-U
+    # regime — the Si-supercell flagship class. ----
+    gsh = None
+    g_flag = cfg.control.gshard
+    ndev = len(jax.devices())
+    gsh_want = False
+    if (
+        not serial_bands and g_flag not in (False, "false", "off")
+        and nk == 1 and ns == 1 and hub is None and ndev > 1
+        and ctx.beta.num_beta_total
+    ):
+        # replicated per-device footprint: projector table + psi workspace
+        foot = (ctx.beta.num_beta_total + 4 * nb) * ctx.gkvec.ngk_max * 16
+        dims_ok = (
+            ctx.fft_coarse.dims[0] % ndev == 0
+            and ctx.fft_coarse.dims[1] % ndev == 0
+        )
+        forced = g_flag in (True, "force")
+        gsh_want = dims_ok and (
+            forced
+            or (g_flag == "auto" and foot > cfg.control.gshard_budget_bytes)
+        )
+        if forced and not dims_ok:
+            import warnings
+
+            warnings.warn(
+                f"control.gshard forced but the coarse box "
+                f"{ctx.fft_coarse.dims} is not divisible by {ndev} devices "
+                "along x and y — falling back to the replicated band solve"
+            )
+
+    def _setup_gshard(dtype):
+        from jax.sharding import Mesh as _Mesh
+
+        from sirius_tpu.ops.hamiltonian import real_dtype_of
+        from sirius_tpu.parallel.dist_fft import (
+            gshard_partition,
+            make_apply_h_s_gshard,
+            reorder_to_gshard,
+        )
+
+        g_mesh = _Mesh(np.array(jax.devices()).reshape(ndev), ("g",))
+        mill0 = np.asarray(ctx.gkvec.millers[0])
+        g_order, g_lidx, _ = gshard_partition(mill0, ctx.fft_coarse.dims, ndev)
+        prm0 = hk_params(0, np.zeros(ctx.fft_coarse.dims), None, dtype)
+        g_fn, g_sharding = make_apply_h_s_gshard(
+            g_mesh, ctx.fft_coarse.dims, g_lidx,
+            reorder_to_gshard(np.asarray(prm0.ekin), g_order),
+            reorder_to_gshard(np.asarray(prm0.mask), g_order),
+            reorder_to_gshard(np.asarray(prm0.beta), g_order),
+            np.asarray(prm0.dion), np.asarray(prm0.qmat),
+            np.zeros(ctx.fft_coarse.dims),
+        )
+        g_mask = jnp.asarray(reorder_to_gshard(np.asarray(prm0.mask), g_order))
+        return dict(fn=g_fn, order=g_order, sharding=g_sharding,
+                    mask=g_mask, psi=None, dtype=dtype,
+                    rdt=real_dtype_of(dtype))
+
+    if gsh_want:
+        gsh = _setup_gshard(wf_dtype)
+        scf_mesh = None  # the "g" mesh replaces the (k, b) mesh
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
     etot_history, rms_history, mag_history = [], [], []
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
@@ -422,7 +486,73 @@ def run_scf(
             d_by_spin = paw_mod.add_dij_to_d(paw, paw_res["dij_atoms"], d_by_spin)
         v0 = float(np.real(pot.veff_g[0]))
         with profile("scf::band_solve"):
-            if serial_bands:
+            if gsh is not None:
+                from sirius_tpu.ops.hamiltonian import real_dtype_of
+                from sirius_tpu.parallel.dist_fft import (
+                    reorder_from_gshard,
+                    reorder_to_gshard,
+                )
+
+                if gsh["dtype"] != wf_dtype:
+                    # fp32 -> fp64 polish: rebuild ekin/mask/beta tables at
+                    # the new precision (the serial path gets this from the
+                    # (ik, dtype)-keyed hk_params cache)
+                    gsh = _setup_gshard(wf_dtype)
+
+                if psi is None and psi_big is not None:
+                    # one-off LCAO subspace init on the replicated path
+                    params = hk_params(
+                        0, pot.veff_r_coarse[0], d_by_spin[0], wf_dtype
+                    )
+                    xb = psi_big[0, 0] * np.asarray(ctx.gkvec.mask[0])
+                    hx, sx = apply_h_s(params, jnp.asarray(xb, dtype=wf_dtype))
+                    psi = np.zeros(
+                        (1, 1, nb, ctx.gkvec.ngk_max), dtype=np.complex128
+                    )
+                    psi[0, 0] = _subspace_rotate_host(
+                        xb, np.asarray(hx, dtype=np.complex128),
+                        np.asarray(sx, dtype=np.complex128), nb,
+                    )
+                    counters["num_loc_op_applied"] += psi_big.shape[2]
+                    psi_big = None
+                x0 = gsh["psi"]
+                if x0 is None:
+                    x0 = jax.device_put(
+                        jnp.asarray(reorder_to_gshard(
+                            np.asarray(psi[0, 0]).astype(wf_dtype),
+                            gsh["order"],
+                        )),
+                        gsh["sharding"],
+                    )
+                h_diag, o_diag = _h_o_diag(ctx, 0, v0, d_by_spin[0])
+                hd = reorder_to_gshard(np.asarray(h_diag), gsh["order"])
+                od = reorder_to_gshard(np.asarray(o_diag), gsh["order"])
+                od[od == 0.0] = 1.0  # padding slots: finite preconditioner
+                rdt = real_dtype_of(wf_dtype)
+                veff_d = jax.device_put(
+                    jnp.asarray(pot.veff_r_coarse[0]),
+                    gsh["fn"].sharding_veff,
+                )
+                ev, x, rn = davidson(
+                    gsh["fn"],
+                    (veff_d, jnp.asarray(d_by_spin[0], dtype=gsh["rdt"])),
+                    x0,
+                    jnp.asarray(hd, dtype=rdt), jnp.asarray(od, dtype=rdt),
+                    gsh["mask"],
+                    num_steps=itsol.num_steps,
+                    res_tol=itsol.residual_tolerance,
+                )
+                gsh["psi"] = x
+                evals[0, 0] = np.asarray(ev)
+                # host round-trip for the density consumer; a device-side
+                # gather + sharded density accumulation would avoid it
+                # (known cost on this path — the band solve dominates)
+                psi = jnp.asarray(
+                    reorder_from_gshard(
+                        np.asarray(x), gsh["order"], ctx.gkvec.ngk_max
+                    )
+                )[None, None]
+            elif serial_bands:
                 if psi is None and psi_big is not None:
                     # first iteration from a fresh LCAO block: rotate the
                     # full atomic-orbital subspace down to nb Ritz vectors
@@ -598,7 +728,7 @@ def run_scf(
         # --- density (per spin, then charge/magnetization assembly) ---
         occ_w = jnp.asarray(occ_np * ctx.kweights[:, None, None])
         with profile("scf::density"):
-            if serial_bands:
+            if serial_bands or gsh is not None:
                 rho_spin = generate_density_g(ctx, psi, occ_np)
             else:
                 from sirius_tpu.dft.density import density_from_coarse_acc
@@ -738,6 +868,8 @@ def run_scf(
             and rms < cfg.settings.fp32_to_fp64_rms
         ):
             wf_dtype = jnp.complex128
+            if gsh is not None:
+                gsh["psi"] = None  # rebuild the sharded block in fp64
             continue
         if de < p.energy_tol and rms < p.density_tol:
             converged = True
@@ -768,6 +900,7 @@ def run_scf(
     result = {
         "converged": converged,
         "num_scf_iterations": num_iter_done,
+        "gshard_devices": ndev if gsh is not None else 0,
         "efermi": float(mu),
         "band_gap": band_gap,
         "rho_min": float(rho_r.min()),

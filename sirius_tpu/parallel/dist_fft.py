@@ -308,12 +308,18 @@ def make_apply_h_s_gshard(mesh: Mesh, dims, lidx, ekin_g, mask_g,
     inner = _gshard_inner(mesh, n1p, n2, n3)
 
     def apply_h_s_gshard(params, psi):
-        """davidson-compatible apply: params is the (device-put, y-slab
-        sharded) effective potential — the ONLY leaf that changes between
-        SCF iterations; pass a new one via jax.device_put(veff,
-        sharding_veff) without retracing."""
-        v = veff_d if params is None else params
-        return inner(psi, ekin_d, mask_d, beta_d, lidx_d, dion_d, qmat_d, v)
+        """davidson-compatible apply. params:
+          None              -> factory veff + factory dion
+          veff              -> new potential, factory dion
+          (veff, dion)      -> per-SCF-iteration potential AND screened D
+        (all leaves same shape/sharding as the factory ones, so iterations
+        reuse the compiled program without retracing)."""
+        d = dion_d
+        if isinstance(params, tuple):
+            v, d = params
+        else:
+            v = veff_d if params is None else params
+        return inner(psi, ekin_d, mask_d, beta_d, lidx_d, d, qmat_d, v)
 
     apply_h_s_gshard.sharding_veff = veff_sharding
     apply_h_s_gshard.veff0 = veff_d

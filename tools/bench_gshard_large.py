@@ -1,0 +1,61 @@
+"""Record the flagship large-tier SCF through run_scf on an n-device "g"
+mesh (VERDICT r4 item 5: the G-sharded operator dispatched from run_scf at
+the Si-supercell scale, not a demo). Writes GSHARD_LARGE.json.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python tools/bench_gshard_large.py [ndev]
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import numpy as np
+
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ndev = len(jax.devices())
+    ctx = synthetic_silicon_context(
+        gk_cutoff=5.0, pw_cutoff=15.0, ngridk=(1, 1, 1), num_bands=512,
+        use_symmetry=False, supercell=3,
+        extra_params={"num_dft_iter": 2},
+    )
+    ctx.cfg.control.gshard = "force"
+    ctx.cfg.iterative_solver.num_steps = 10
+    t0 = time.time()
+    res = run_scf(ctx.cfg, ctx=ctx)
+    wall = time.time() - t0
+    niter = res["num_scf_iterations"]
+    out = {
+        "what": "run_scf large tier (Si-54atom US, 512 bands) with the "
+                "G-sharded slab-FFT band solve auto-dispatched over the "
+                "'g' mesh",
+        "ndev": ndev,
+        "platform": jax.devices()[0].platform,
+        "host_ncpu": os.cpu_count(),
+        "num_scf_iterations": niter,
+        "wall_s_total": round(wall, 1),
+        "s_per_iteration": round(wall / max(niter, 1), 2),
+        "etot_first_iters": [round(float(x), 6) for x in res["etot_history"]],
+        "ngk": int(ctx.gkvec.ngk_max),
+        "nbeta_total": int(ctx.beta.num_beta_total),
+    }
+    with open(os.path.join(REPO, "GSHARD_LARGE.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
