@@ -1,0 +1,187 @@
+"""Chunked beta projectors: fixed-shape atom chunks generated ON THE FLY
+inside the Hamiltonian application (reference
+beta_projectors_base.hpp:52,287 + create_beta_gk.cu: the full
+[nbeta_total x ngk] table is never materialized — each chunk of atoms is
+(re)generated from per-TYPE radial tables and structure phases, applied,
+and discarded).
+
+TPU design: a lax.scan over atom chunks. Each step builds the chunk's
+projector block as
+
+    beta[c, xi, G] = pref * (-i)^l * R_lm(^G+k) * RI_rf(|G+k|) * e^{-2pi i (G+k).r_c}
+
+from (a) dense per-radial-function q-tables (linear interpolation inside
+jit), (b) the real-harmonics table R_lm at the k's G directions, and (c)
+the chunk's atom positions — all fixed-shape, so the scan compiles once.
+Peak projector memory is [chunk, nxi_max, ngk] instead of
+[nbeta_total, ngk]: the Si-511-class memory wall (VERDICT r4 item 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.core.sht import lm_index, num_lm, ylm_real
+
+
+@dataclasses.dataclass
+class BetaChunkTables:
+    """Per-k chunked-projector tables (host numpy; upload via params)."""
+
+    # static geometry/metadata, padded per atom to nxi_max
+    nxi_max: int
+    chunk: int  # atoms per scan step
+    # per-CHUNKED-atom arrays [n_steps, chunk, ...]
+    pos: np.ndarray  # [S, C, 3] lattice coords
+    xi_rf: np.ndarray  # [S, C, nxi] row into ri_grid
+    xi_lm: np.ndarray  # [S, C, nxi] lm index into rlm
+    xi_cph: np.ndarray  # [S, C, nxi] complex (-i)^l prefactor (0 for pad)
+    dmat: np.ndarray  # [S, C, nxi, nxi] screened D blocks
+    qmat: np.ndarray  # [S, C, nxi, nxi] Q blocks (zeros for NC)
+    # per-k tables
+    rlm: np.ndarray  # [ngk, lmmax]
+    q: np.ndarray  # [ngk] |G+k|
+    mk: np.ndarray  # [ngk, 3] millers + k
+    ri_grid: np.ndarray  # [nrf_tot, NQ] dense radial tables
+    dq: float
+    pref: float  # 4 pi / sqrt(omega)
+
+
+def build_tables(ctx, ik: int, d_full: np.ndarray | None = None,
+                 chunk: int = 16) -> BetaChunkTables:
+    """Chunk tables for one k. d_full: the screened [nbeta_tot, nbeta_tot]
+    D (defaults to the bare dion); its per-atom diagonal blocks are what
+    the chunked apply uses — exactly apply_h_s's contraction restricted to
+    the block-diagonal structure D actually has (D couples xi within one
+    atom only, non_local_operator.hpp)."""
+    uc = ctx.unit_cell
+    nat = uc.num_atoms
+    qmax = ctx.cfg.parameters.gk_cutoff * 1.05 + 1e-9
+
+    # dense radial tables over every species' beta radial functions
+    from sirius_tpu.ops.beta import beta_radial_table
+
+    NQ = max(2048, int(qmax * 192))
+    qs = np.linspace(0.0, qmax, NQ)
+    ri_rows = []
+    rf_off_type = []
+    for t in uc.atom_types:
+        rf_off_type.append(len(ri_rows))
+        tab = beta_radial_table(t, qmax)
+        if tab is None:
+            continue
+        vals = tab(qs)  # [num_beta_rf, NQ]
+        for r in np.atleast_2d(vals):
+            ri_rows.append(r)
+    ri_grid = np.asarray(ri_rows) if ri_rows else np.zeros((1, NQ))
+
+    lmax = max((t.lmax_beta for t in uc.atom_types if t.num_beta), default=0)
+    nxi_max = max(
+        (sum(2 * b.l + 1 for b in uc.atom_types[uc.type_of_atom[ia]].beta)
+         for ia in range(nat)),
+        default=1,
+    )
+    n_steps = (nat + chunk - 1) // chunk
+    pos = np.zeros((n_steps, chunk, 3))
+    xi_rf = np.zeros((n_steps, chunk, nxi_max), dtype=np.int32)
+    xi_lm = np.zeros((n_steps, chunk, nxi_max), dtype=np.int32)
+    xi_cph = np.zeros((n_steps, chunk, nxi_max), dtype=np.complex128)
+    dmat = np.zeros((n_steps, chunk, nxi_max, nxi_max))
+    qmat = np.zeros((n_steps, chunk, nxi_max, nxi_max))
+    d_src = d_full if d_full is not None else ctx.beta.dion
+    q_src = ctx.beta.qmat
+    for ia, off, nbf in ctx.beta.atom_blocks(uc):
+        s, c = divmod(ia, chunk)
+        t = uc.atom_types[uc.type_of_atom[ia]]
+        pos[s, c] = uc.positions[ia]
+        idxrf, ls, ms = t.beta_lm_table()
+        for xi in range(nbf):
+            l, m, ir = int(ls[xi]), int(ms[xi]), int(idxrf[xi])
+            xi_rf[s, c, xi] = rf_off_type[uc.type_of_atom[ia]] + ir
+            xi_lm[s, c, xi] = lm_index(l, m)
+            xi_cph[s, c, xi] = (-1j) ** l
+        dmat[s, c, :nbf, :nbf] = np.real(d_src[off : off + nbf, off : off + nbf])
+        if q_src is not None:
+            qmat[s, c, :nbf, :nbf] = np.real(
+                q_src[off : off + nbf, off : off + nbf]
+            )
+
+    gk = np.asarray(ctx.gkvec.gkcart[ik])
+    q = np.linalg.norm(gk, axis=-1)
+    rhat = np.where(
+        q[:, None] > 1e-30, gk / np.maximum(q, 1e-30)[:, None],
+        np.array([0.0, 0.0, 1.0]),
+    )
+    rlm = ylm_real(lmax, rhat)[:, : num_lm(lmax)]
+    mk = np.asarray(ctx.gkvec.millers[ik]) + np.asarray(ctx.gkvec.kpoints[ik])[None, :]
+    return BetaChunkTables(
+        nxi_max=nxi_max, chunk=chunk, pos=pos, xi_rf=xi_rf, xi_lm=xi_lm,
+        xi_cph=xi_cph, dmat=dmat, qmat=qmat, rlm=rlm, q=q, mk=mk,
+        ri_grid=ri_grid, dq=float(qs[1] - qs[0]),
+        pref=4.0 * np.pi / np.sqrt(uc.omega),
+    )
+
+
+def chunked_nonlocal(tb: BetaChunkTables, psi: jax.Array, mask=None,
+                     dtype=None):
+    """(sum_chunks beta^T D <beta|psi>, same with Q): the non-local H and
+    S corrections, computed without ever holding more than one chunk of
+    projectors. psi: [nb, ngk]; mask zeroes the padded G slots (the dense
+    table carries the mask baked in; generated chunks must apply it)."""
+    dtype = dtype or psi.dtype
+    rdt = jnp.real(jnp.zeros((), dtype)).dtype
+    q = jnp.asarray(tb.q, dtype=rdt)
+    rlm = jnp.asarray(tb.rlm, dtype=rdt)
+    mk = jnp.asarray(tb.mk, dtype=rdt)
+    ri_grid = jnp.asarray(tb.ri_grid, dtype=rdt)
+    iq = jnp.clip(q / tb.dq, 0.0, ri_grid.shape[1] - 1.001)
+    i0 = iq.astype(jnp.int32)
+    tfrac = (iq - i0).astype(rdt)
+    # interpolate each DISTINCT radial function once, outside the scan;
+    # chunks then just gather rows (same-type atoms share them)
+    ri_all = ri_grid[:, i0] * (1.0 - tfrac) + ri_grid[:, i0 + 1] * tfrac
+    if mask is not None:
+        # the dense table bakes the G mask into every projector row
+        # (beta.py BetaProjectors.build); bake it here the same way so
+        # <beta|psi> ignores padded slots regardless of psi's content
+        ri_all = ri_all * mask
+
+    def step(carry, chunk):
+        hacc, sacc = carry
+        pos_c, rf_c, lm_c, cph_c, d_c, q_c = chunk
+        ri = ri_all[rf_c]  # [C, nxi, ngk]
+        ang = rlm[:, lm_c]  # [ngk, C, nxi]
+        phase = jnp.exp(
+            (-2j * jnp.pi) * (mk @ pos_c.T).astype(rdt)
+        ).astype(dtype)  # [ngk, C]
+        beta_c = (
+            tb.pref
+            * cph_c[:, :, None]
+            * jnp.transpose(ang, (1, 2, 0)).astype(dtype)
+            * ri.astype(dtype)
+            * jnp.transpose(phase)[:, None, :]
+        )  # [C, nxi, ngk]
+        bp = jnp.einsum("cxg,bg->bcx", jnp.conj(beta_c), psi)
+        hacc = hacc + jnp.einsum(
+            "bcx,cxy,cyg->bg", bp, d_c.astype(rdt), beta_c
+        )
+        sacc = sacc + jnp.einsum(
+            "bcx,cxy,cyg->bg", bp, q_c.astype(rdt), beta_c
+        )
+        return (hacc, sacc), None
+
+    z = jnp.zeros(psi.shape, dtype)
+    chunks = (
+        jnp.asarray(tb.pos, dtype=rdt),
+        jnp.asarray(tb.xi_rf),
+        jnp.asarray(tb.xi_lm),
+        jnp.asarray(tb.xi_cph, dtype=dtype),
+        jnp.asarray(tb.dmat, dtype=rdt),
+        jnp.asarray(tb.qmat, dtype=rdt),
+    )
+    (h, s), _ = jax.lax.scan(step, (z, z), chunks)
+    return h, s

@@ -27,7 +27,7 @@ def main() -> int:
 
     ndev = len(jax.devices())
     ctx = synthetic_silicon_context(
-        gk_cutoff=5.0, pw_cutoff=15.0, ngridk=(1, 1, 1), num_bands=512,
+        gk_cutoff=5.0, pw_cutoff=15.0, ngridk=(1, 1, 1), num_bands=256,
         use_symmetry=False, supercell=3,
         extra_params={"num_dft_iter": 2},
     )
@@ -38,7 +38,7 @@ def main() -> int:
     wall = time.time() - t0
     niter = res["num_scf_iterations"]
     out = {
-        "what": "run_scf large tier (Si-54atom US, 512 bands) with the "
+        "what": "run_scf large tier (Si-54atom US, 256 bands) with the "
                 "G-sharded slab-FFT band solve auto-dispatched over the "
                 "'g' mesh",
         "ndev": ndev,

@@ -3,7 +3,7 @@
 # re-records decks affected by the pw_mod/mixer/lo/constraint fixes.
 cd /root/repo
 while pgrep -f "run_decks_seq.sh" > /dev/null; do sleep 60; done
-ORDER="test21 test32 test29 test14 test03 test18 test16 test09 test27 test28 test07 test17 test30 test12"
+ORDER="test22 test21 test32 test29 test14 test03 test18 test16 test09 test27 test28 test07 test17 test30 test12"
 for t in $ORDER; do
   echo "[rerun] $t start $(date +%H:%M:%S)" >> /tmp/decks_rerun.log
   timeout 7200 python tools/run_decks.py "$t" >> /tmp/decks_rerun.log 2>&1
