@@ -58,14 +58,17 @@ class Mixer:
         self.beta = cfg.beta
         self.max_history = cfg.max_history
         self.kind = "anderson" if cfg.type == "broyden1" else cfg.type
+        self.use_hartree = bool(cfg.use_hartree)
         self.weight = None
         self.rms_weight = None  # per-coefficient weight of the normalized rms
+        self._eha_w = None  # 2 pi Omega / G^2 over the charge channel
         if glen2 is not None:
             if omega is None:
                 raise ValueError("Mixer needs omega together with glen2")
             ng = len(glen2)
+            g2 = np.where(glen2 > 1e-12, glen2, np.inf)
+            self._eha_w = 2.0 * np.pi * omega / g2
             if cfg.use_hartree:
-                g2 = np.where(glen2 > 1e-12, glen2, np.inf)
                 w_charge = 4.0 * np.pi / g2
                 # normalized by size = 1/Omega (mixer_functions.cpp
                 # periodic_function_property_modified) -> MULTIPLIED by Omega
@@ -90,6 +93,18 @@ class Mixer:
     def _inner(self, a: np.ndarray, b: np.ndarray) -> float:
         w = self.weight if self.weight is not None else 1.0
         return float(np.real(np.sum(w * np.conj(a) * b)))
+
+    def residual_hartree_energy(self, x_mixed: np.ndarray, x_new: np.ndarray):
+        """Hartree energy of the charge-channel residual (mixed - new):
+        2 pi Omega sum_{G!=0} |drho_G|^2 / G^2 — the quantity the reference
+        tests against density_tol when use_hartree is on (poisson.cpp
+        density_residual_hartree_energy, dft_ground_state.cpp:251,353).
+        None when the mixer has no G-space charge channel (FP-LAPW vector)."""
+        if self._eha_w is None:
+            return None
+        n = len(self._eha_w)
+        d = x_mixed[:n] - x_new[:n]
+        return float(np.real(np.sum(self._eha_w * np.conj(d) * d)))
 
     def rms(self, x_in: np.ndarray, x_out: np.ndarray) -> float:
         """sqrt of the sum over channels of inner(d,d)/size (reference

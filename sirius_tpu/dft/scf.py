@@ -793,6 +793,15 @@ def run_scf(
             )
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
+        # density criterion in the reference's metric: with use_hartree the
+        # bar is the Hartree ENERGY of (mixed - new), not the rms
+        # (dft_ground_state.cpp:251,353) — quadratic in the residual, so
+        # testing the Hartree-metric rms against the same density_tol is a
+        # far stricter (square-root) bar and stalls decks at 100 iterations
+        eha_res = mixer.residual_hartree_energy(x_mix, x_new)
+        dens_metric = (
+            eha_res if (mixer.use_hartree and eha_res is not None) else rms
+        )
         rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, lam_mixed = unpack(x_mix)
         if lam_mixed is not None:
             hub_lagrange = lam_mixed  # quasi-Newton-mixed multipliers
@@ -871,7 +880,7 @@ def run_scf(
             if gsh is not None:
                 gsh["psi"] = None  # rebuild the sharded block in fp64
             continue
-        if de < p.energy_tol and rms < p.density_tol:
+        if de < p.energy_tol and dens_metric < p.density_tol:
             converged = True
             break
 

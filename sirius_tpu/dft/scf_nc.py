@@ -262,6 +262,12 @@ def run_scf_nc(
         x_new = pack(rho_new, mvec_new)
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
+        # use_hartree density bar = Hartree energy of (mixed - new), the
+        # reference's convergence metric (dft_ground_state.cpp:251,353)
+        eha_res = mixer.residual_hartree_energy(x_mix, x_new)
+        dens_metric = (
+            eha_res if (mixer.use_hartree and eha_res is not None) else rms
+        )
         rho_g, mvec_g = unpack(x_mix)
 
         def _epot(r_out, m_out, p_):
@@ -290,7 +296,7 @@ def run_scf_nc(
         num_iter_done = it + 1
         de = abs(e_total - e_prev) if e_prev is not None else np.inf
         e_prev = e_total
-        if de < p.energy_tol and rms < p.density_tol:
+        if de < p.energy_tol and dens_metric < p.density_tol:
             converged = True
             break
 

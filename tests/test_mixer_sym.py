@@ -138,3 +138,30 @@ def test_symmetrize_pw_projector():
         # invariance: f(w_k g) = f(g) e^{-2 pi i (w_k g).t}
         phase = np.exp(2j * np.pi * (gm @ op.t))
         np.testing.assert_allclose(fs[pidx] * phase, fs, atol=1e-10)
+
+
+def test_residual_hartree_energy_metric():
+    """use_hartree convergence metric parity (reference poisson.cpp
+    density_residual_hartree_energy): E_H[drho] = 2 pi Omega sum_{G!=0}
+    |drho_G|^2 / G^2, quadratic in the residual — NOT the Hartree-metric
+    rms (whose square root scaling stalls use_hartree decks at the same
+    density_tol)."""
+    rng = np.random.default_rng(3)
+    ng, omega = 25, 100.0
+    glen2 = np.concatenate([[0.0], rng.uniform(0.5, 9.0, ng - 1)])
+    cfg = MixerConfig(type="anderson", beta=0.5, use_hartree=True)
+    mixer = Mixer(cfg, glen2=glen2, num_components=1, omega=omega)
+    d = rng.standard_normal(ng) + 1j * rng.standard_normal(ng)
+    x_new = rng.standard_normal(ng) + 1j * rng.standard_normal(ng)
+    eha = mixer.residual_hartree_energy(x_new + d, x_new)
+    expect = 2.0 * np.pi * omega * np.sum(np.abs(d[1:]) ** 2 / glen2[1:])
+    np.testing.assert_allclose(eha, expect, rtol=1e-12)
+    # quadratic scaling (the point of the parity fix) + G=0 exclusion
+    np.testing.assert_allclose(
+        mixer.residual_hartree_energy(x_new + 2 * d, x_new), 4 * eha,
+        rtol=1e-12,
+    )
+    d0 = np.zeros(ng, complex); d0[0] = 7.0
+    assert mixer.residual_hartree_energy(x_new + d0, x_new) == 0.0
+    # FP-LAPW mixer (no G channel) has no such metric
+    assert Mixer(cfg).residual_hartree_energy(x_new, x_new) is None

@@ -16,6 +16,11 @@ import os
 import sys
 import time
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo root on sys.path: `python tools/run_decks.py` puts tools/ (not the
+# repo) at sys.path[0], and PYTHONPATH is owned by the axon sitecustomize
+sys.path.insert(0, REPO)
+
 # verification decks run the fp64 path: force the CPU backend BEFORE any
 # other jax use (the env var is unreliable under the axon sitecustomize;
 # see tests/conftest.py and .claude memory tpu-axon-backend-contract)
@@ -23,7 +28,6 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 VER = "/root/reference/verification"
 
 # ALL 31 reference decks are wired; pass/fail recorded honestly per deck

@@ -23,6 +23,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # `python tools/...` puts tools/, not the repo, first
 
 
 def record(tier: str) -> int:
