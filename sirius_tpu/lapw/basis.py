@@ -63,6 +63,12 @@ class AtomRadialBasis:
     enu: list
     lo_enu: list = dataclasses.field(default_factory=list)  # resolved, per lo
     minv_R: float = 1.0  # 1/M(R) of the valence relativity (ZORA/IORA)
+    # per-l APW matching order: 2 = LAPW (value + slope with u, udot),
+    # 1 = APW (value only; aw[l][1] is a zero pad). Default 2 everywhere.
+    aw_order: list = dataclasses.field(default_factory=list)
+
+    def order(self, l: int) -> int:
+        return self.aw_order[l] if self.aw_order else 2
 
     def overlap(self, f1: MtRadial, f2: MtRadial) -> float:
         return float(rint(f1.f * f2.f * self.r**2, self.r))
@@ -103,7 +109,7 @@ def find_enu(r, v_sph, l: int, n: int, rel: str = "none") -> float:
 def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
                        rel: str = "none") -> AtomRadialBasis:
     r = sp.r
-    aw, enu_l = [], []
+    aw, enu_l, aw_order = [], [], []
     for l in range(lmax_apw + 1):
         basis = sp.aw_basis(l)
         e0 = basis[0].enu
@@ -111,10 +117,26 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
             n = basis[0].n if basis[0].n > 0 else l + 1
             e0 = find_enu(r, v_sph, l, n, rel)
         u, ud, uR, upR, udR, udpR = radial_solution_with_edot(r, v_sph, l, e0, rel)
-        aw.append([
-            MtRadial(l=l, f=u, hf=e0 * u, fR=uR, fpR=upR),
-            MtRadial(l=l, f=ud, hf=e0 * ud + u, fR=udR, fpR=udpR),
-        ])
+        if len(basis) == 1:
+            # true APW species (one radial function per l, value-only
+            # boundary matching; reference atom_type aw_default_l with a
+            # single descriptor — test17/test19 class). The second slot is
+            # zero-padded so every consumer (fv blocks, mt_index layout,
+            # density accumulation) keeps the fixed (u, udot) shape; the
+            # matching coefficient B of this channel is exactly zero so the
+            # pad never contributes.
+            z = np.zeros_like(u)
+            aw.append([
+                MtRadial(l=l, f=u, hf=e0 * u, fR=uR, fpR=upR),
+                MtRadial(l=l, f=z, hf=z, fR=0.0, fpR=0.0),
+            ])
+            aw_order.append(1)
+        else:
+            aw.append([
+                MtRadial(l=l, f=u, hf=e0 * u, fR=uR, fpR=upR),
+                MtRadial(l=l, f=ud, hf=e0 * ud + u, fR=udR, fpR=udpR),
+            ])
+            aw_order.append(2)
         enu_l.append(e0)
     lo = []
     lo_enu = []
@@ -195,7 +217,7 @@ def build_radial_basis(sp, v_sph: np.ndarray, lmax_apw: int,
         minv_R = 1.0 / (1.0 - SQ_ALPHA_HALF * float(v_sph[-1]))
     return AtomRadialBasis(
         lmax_apw=lmax_apw, r=r, aw=aw, lo=lo, enu=enu_l, lo_enu=lo_enu,
-        minv_R=minv_R,
+        minv_R=minv_R, aw_order=aw_order,
     )
 
 
@@ -275,11 +297,17 @@ def matching_coefficients(gkvec_cart: np.ndarray, pos_frac: np.ndarray,
     B = np.zeros_like(A)
     for l in range(lmax + 1):
         u, ud = basis.aw[l]
-        det = u.fR * ud.fpR - u.fpR * ud.fR
         rhs1 = jl[l]
         rhs2 = g * djl[l]
-        a = (rhs1 * ud.fpR - rhs2 * ud.fR) / det
-        b = (rhs2 * u.fR - rhs1 * u.fpR) / det
+        if basis.order(l) == 1:
+            # APW: match the plane-wave VALUE only with the single radial
+            # function (reference matching_coefficients.hpp order-1 branch)
+            a = rhs1 / u.fR
+            b = np.zeros_like(rhs2)
+        else:
+            det = u.fR * ud.fpR - u.fpR * ud.fR
+            a = (rhs1 * ud.fpR - rhs2 * ud.fR) / det
+            b = (rhs2 * u.fR - rhs1 * u.fpR) / det
         il = 1j**l
         for m in range(-l, l + 1):
             lm = lm_index(l, m)

@@ -85,3 +85,65 @@ def test_empty_lattice_free_electrons(kfrac):
     gk = (mill + k) @ recip
     e_free = np.sort(0.5 * np.sum(gk**2, axis=1))[:nev]
     assert np.abs(e - e_free).max() < 2e-3, (e, e_free)
+
+
+class _FakeSpeciesAPW(_FakeSpecies):
+    """Single radial function per l: true APW (value-only matching)."""
+
+    def aw_basis(self, l):
+        class E:
+            enu = 0.25
+            auto = 0
+            dme = 0
+            n = 0
+
+        return [E()]
+
+
+def test_empty_lattice_apw_order1():
+    """True APW (aw order 1, reference matching_coefficients.hpp case 1):
+    with V = 0 and enu equal to the exact band energy, u_l ~ j_l(sqrt(2E) r)
+    and value-only matching is exact — the lowest empty-lattice eigenvalue
+    must come out at |k|^2/2 despite the missing udot channel. Exercises the
+    zero-padded second slot end to end (assembly, lo blocks absent, solve)."""
+    a = 6.0
+    lattice = np.eye(3) * a
+    omega = a**3
+    rmt = 2.0
+    lmax = 6
+    kfrac = np.array([0.25, 0.1, 0.0])
+    recip = 2.0 * np.pi * np.linalg.inv(lattice).T
+    e_target = 0.5 * np.sum((kfrac @ recip) ** 2)
+
+    sp = _FakeSpeciesAPW(rmt=rmt)
+    # enu must equal the target band energy for APW to be exact
+    class E:
+        enu = float(e_target)
+        auto = 0
+        dme = 0
+        n = 0
+
+    sp.aw_basis = lambda l: [E()]
+    basis = build_radial_basis(sp, np.zeros_like(sp.r), lmax)
+    assert basis.order(0) == 1 and basis.aw[0][1].fR == 0.0
+
+    mill = _gvec_set(lattice, 3.2)
+    dims = (32, 32, 32)
+    fi, fj, fk = np.meshgrid(
+        np.fft.fftfreq(dims[0], 1 / dims[0]).astype(int),
+        np.fft.fftfreq(dims[1], 1 / dims[1]).astype(int),
+        np.fft.fftfreq(dims[2], 1 / dims[2]).astype(int),
+        indexing="ij",
+    )
+    mill_fine = np.stack([fi.ravel(), fj.ravel(), fk.ravel()], axis=1)
+    pos = np.array([[0.0, 0.0, 0.0]])
+    theta = step_function_g(
+        lattice, pos, np.array([rmt]), mill_fine @ recip, mill_fine
+    )
+    th_box = theta.reshape(dims)
+    H, O = assemble_fv(
+        mill, kfrac, lattice, pos, [rmt], [basis],
+        [None], th_box, np.zeros_like(th_box), dims, omega,
+    )
+    e, _ = diagonalize_fv(H, O, 1)
+    assert abs(e[0] - e_target) < 5e-5, (e[0], e_target)
