@@ -635,15 +635,22 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
     close enough the constraint RELEASES — it is a starter that prepares
     the occupancy, not a permanent penalty (reference hubbard_matrix.hpp:227).
 
-    Sign note: with the constraint potential applied as V -= strength *
-    lambda (hubbard_potential_energy.cpp:33), stability of the multiplier
-    loop requires lambda to grow POSITIVE (attractive) on under-occupied
-    orbitals — gradient ascent on the Lagrange dual of PRB 102, 235159.
-    The snapshot's literal `lambda += beta*(om - om_ref)` paired with
-    `V -= lambda` is a positive-feedback loop that provably cannot reach
-    targets like test30's (and any om symmetrization makes that target
-    unreachable outright); the recorded reference outputs require the
-    stable saddle-point dynamics implemented here.
+    Sign note: the literal reference dynamics is `lambda += beta*(om -
+    om_ref)` paired with `V -= strength*lambda` (hubbard_potential_energy
+    .cpp:33, occupation_matrix.cpp:341) — positive feedback that drives the
+    occupancy AWAY from the target, and the reference's own test30 output
+    shows exactly that (atom 0 constrained to moment -1, output_ref lands
+    at +1.81). Replaying those literal dynamics here was tried and NaNs by
+    iteration ~14: our first-generate om sits farther from the target than
+    the reference's (different first-iteration subspace), so the constraint
+    never releases and the multipliers run away. We keep the STABLE
+    dual-ascent sign (lambda -= beta*diff, gradient ascent on the Lagrange
+    dual of PRB 102, 235159): the constraint is actually satisfied, then
+    released by the same error rule. test30 therefore reaches the genuine
+    constrained state (mag -1.0, on target) instead of the reference's
+    runaway one — a knowing parity deviation; its DECKS.json record shows
+    the consequence honestly (dE 1.18 vs the runaway-state reference
+    energy, SCF itself not settled within 100 iterations).
 
     state: {"err": float, "steps": int} carried by the SCF loop. Returns
     (lagrange, active_for_next_potential)."""
