@@ -31,6 +31,9 @@ class PotentialResult:
     vha_g: np.ndarray
     vxc_g: np.ndarray  # fine G: XC potential alone (forces/NLCC)
     energies: dict
+    # mGGA only: per-spin v_tau = de/dtau on the COARSE box for the
+    # -1/2 div(v_tau grad) operator (ops/mgga.py); None otherwise
+    vtau_r_coarse: np.ndarray | None = None
 
 
 def _to_r(ctx, f_g):
@@ -74,9 +77,18 @@ def generate_potential(
     rho_g: np.ndarray,
     xc: XCFunctional,
     mag_g: np.ndarray | None = None,
+    tau_g: np.ndarray | None = None,
 ) -> PotentialResult:
+    """tau_g (mGGA only): per-spin kinetic-energy density [ns, num_gvec]
+    on the fine G set (ops/mgga.tau_kset through density_from_coarse_acc)."""
     dims = ctx.gvec.fft.dims
     polarized = mag_g is not None
+    if xc.is_mgga and tau_g is None:
+        raise ValueError("mGGA functional needs tau_g")
+    tau_r = (
+        None if tau_g is None
+        else np.stack([_to_r(ctx, t) for t in np.atleast_2d(tau_g)])
+    )
 
     vha_g = np.asarray(
         hartree_potential_g(jnp.asarray(rho_g), jnp.asarray(ctx.gvec.glen2))
@@ -100,9 +112,16 @@ def generate_potential(
             suu = sum(g * g for g in gu)
             sdd = sum(g * g for g in gd)
             sud = sum(a * b for a, b in zip(gu, gd))
+            taus = {}
+            if xc.is_mgga:
+                taus = dict(
+                    tau_up=jnp.asarray(tau_r[0].ravel()),
+                    tau_dn=jnp.asarray(tau_r[1].ravel()),
+                )
             out = xc.evaluate_polarized(
                 jnp.asarray(n_up.ravel()), jnp.asarray(n_dn.ravel()),
                 jnp.asarray(suu.ravel()), jnp.asarray(sud.ravel()), jnp.asarray(sdd.ravel()),
+                **taus,
             )
             v_up = np.asarray(out["v_up"]).reshape(dims)
             v_dn = np.asarray(out["v_dn"]).reshape(dims)
@@ -126,7 +145,10 @@ def generate_potential(
         if xc.is_gga:
             g = _gradient_r(ctx, rho_g + ctx.rho_core_g)
             sigma = g[0] ** 2 + g[1] ** 2 + g[2] ** 2
-            out = xc.evaluate(jnp.asarray(rho_xc.ravel()), jnp.asarray(sigma.ravel()))
+            out = xc.evaluate(
+                jnp.asarray(rho_xc.ravel()), jnp.asarray(sigma.ravel()),
+                tau=None if not xc.is_mgga else jnp.asarray(tau_r[0].ravel()),
+            )
             vxc_r = np.asarray(out["v"]).reshape(dims)
             vs = np.asarray(out["vsigma"]).reshape(dims)
             vxc_r = vxc_r - _to_r(ctx, _divergence_g(ctx, [2.0 * vs * gi for gi in g]))
@@ -163,6 +185,24 @@ def generate_potential(
     else:
         veff_r_coarse = to_coarse(veff_g)[None]
 
+    # mGGA: v_tau per spin, smoothed through the coarse G set for the
+    # -1/2 div(v_tau grad) operator; plus the int v_tau tau integral that
+    # the eval_sum double-counting correction needs
+    vtau_r_coarse = None
+    e_vtau_tau = 0.0
+    if xc.is_mgga:
+        if polarized:
+            vt = [
+                np.asarray(out["vtau_up"]).reshape(dims),
+                np.asarray(out["vtau_dn"]).reshape(dims),
+            ]
+        else:
+            vt = [np.asarray(out["vtau"]).reshape(dims)]
+        vtau_r_coarse = np.stack([to_coarse(_to_g(ctx, v)) for v in vt])
+        e_vtau_tau = sum(
+            _inner_rr(ctx, tau_r[s], vt[s]) for s in range(len(vt))
+        )
+
     # energy integrals (reference names; valence rho except exc)
     vloc_r = _to_r(ctx, ctx.vloc_g)
     vha_r = _to_r(ctx, vha_g)
@@ -174,6 +214,7 @@ def generate_potential(
         "veff": _inner_rr(ctx, rho_r, veff_r_fine),
         "exc": _inner_rr(ctx, rho_r + rho_core_r, exc_r),
         "bxc": _inner_rr(ctx, mag_r, _to_r(ctx, bz_g)) if polarized else 0.0,
+        "vtau_tau": e_vtau_tau,
     }
     return PotentialResult(
         veff_g=veff_g,
@@ -182,4 +223,5 @@ def generate_potential(
         vha_g=vha_g,
         vxc_g=vxc_g,
         energies=energies,
+        vtau_r_coarse=vtau_r_coarse,
     )

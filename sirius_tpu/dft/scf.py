@@ -120,6 +120,20 @@ def run_scf(
     xc = XCFunctional(p.xc_functionals)
     nk, ns, nb = ctx.gkvec.num_kpoints, ctx.num_spins, ctx.num_bands
     nel = ctx.unit_cell.num_valence_electrons - p.extra_charge
+    mgga = xc.is_mgga
+    if mgga:
+        if serial_bands:
+            raise NotImplementedError("mGGA: production batched path only")
+        if any(t.paw is not None for t in ctx.unit_cell.atom_types):
+            raise NotImplementedError("mGGA with PAW is not supported")
+        if ctx.aug is not None:
+            import warnings
+
+            warnings.warn(
+                "mGGA with ultrasoft augmentation: tau is computed from the "
+                "smooth wave functions only (no augmentation tau), matching "
+                "the common PW-code approximation"
+            )
 
     if nb * ctx.max_occupancy * ctx.num_spins < nel - 1e-12:
         raise ValueError(
@@ -230,7 +244,12 @@ def run_scf(
         if paw is not None
         else 0.0
     )
-    pot = generate_potential(ctx, rho_g, xc, mag_g)
+    # mGGA bootstrap: no wave functions yet -> tau = 0 (SCAN's alpha = 0
+    # covenant region); replaced by the real tau after the first band solve
+    tau_g = (
+        np.zeros((ns, ctx.gvec.num_gvec), dtype=np.complex128) if mgga else None
+    )
+    pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
     psi_big = None
     if psi is None:
         # full atomic-orbital block (nbig >= nb); rotated down to the lowest
@@ -274,6 +293,16 @@ def run_scf(
     # between iterations, everything else is uploaded once via _replace
     _params_cache: dict = {}
     _kset_cache: dict = {}
+    _gkc_cache: dict = {}
+
+    def _gkc_dev(rdt):
+        """Device-resident cartesian G+k components [nk, ngk, 3] for the
+        mGGA tau operator, uploaded once per working precision."""
+        key = str(rdt)
+        if key not in _gkc_cache:
+            _gkc_cache.clear()  # drop the stale-precision copy
+            _gkc_cache[key] = jnp.asarray(ctx.gkvec.gkcart, dtype=rdt)
+        return _gkc_cache[key]
 
     def kset_params(veff_stack, d_stack, v0, vhub_s, dtype):
         """Batched-path parameters with cached constant tables (only the
@@ -432,6 +461,15 @@ def run_scf(
                 f"{ctx.fft_coarse.dims} is not divisible by {ndev} devices "
                 "along x and y — falling back to the replicated band solve"
             )
+
+    if mgga and gsh_want:
+        # the G-sharded operator has no tau term and the gshard density
+        # branch never updates tau_g — it would silently produce SCAN
+        # energies from tau = 0
+        raise NotImplementedError(
+            "mGGA with the G-sharded band solve is not supported; set "
+            "control.gshard = false"
+        )
 
     def _setup_gshard(dtype):
         from jax.sharding import Mesh as _Mesh
@@ -654,11 +692,21 @@ def run_scf(
                     src = psi if psi is not None else join_cplx(pr, pi)
                     pr, pi = split_cplx(np.asarray(src), rdt)
                     pr, pi = _place_psi(jnp.asarray(pr)), _place_psi(jnp.asarray(pi))
-                ev, pr, pi, rn = davidson_kset(
-                    ps, pr, pi,
-                    num_steps=itsol.num_steps,
-                    res_tol=itsol.residual_tolerance,
-                )
+                if mgga and pot.vtau_r_coarse is not None:
+                    from sirius_tpu.ops.mgga import davidson_kset_mgga
+
+                    ev, pr, pi, rn = davidson_kset_mgga(
+                        ps, jnp.asarray(pot.vtau_r_coarse, dtype=rdt),
+                        _gkc_dev(rdt), pr, pi,
+                        num_steps=itsol.num_steps,
+                        res_tol=itsol.residual_tolerance,
+                    )
+                else:
+                    ev, pr, pi, rn = davidson_kset(
+                        ps, pr, pi,
+                        num_steps=itsol.num_steps,
+                        res_tol=itsol.residual_tolerance,
+                    )
                 # psi stays device-resident as the (pr, pi) pair between
                 # iterations; the complex host copy is materialized only for
                 # consumers that need it (Hubbard occupations each
@@ -737,6 +785,15 @@ def run_scf(
                 rho_spin = density_from_coarse_acc(
                     ctx, np.asarray(density_kset(ps, pr, pi, occ_w))
                 )
+                if mgga:
+                    from sirius_tpu.ops.mgga import tau_kset
+
+                    tau_acc = np.asarray(tau_kset(
+                        ps.fft_index, _gkc_dev(rdt), pr, pi, occ_w,
+                        tuple(ctx.fft_coarse.dims),
+                    ))
+                    # same 1/Omega + coarse->fine mapping as the density
+                    tau_g = density_from_coarse_acc(ctx, tau_acc)
         dm_blocks_by_spin = []
         if ctx.aug is not None:
             from sirius_tpu.dft.density import symmetrize_density_matrix
@@ -836,7 +893,7 @@ def run_scf(
 
         # --- potential + energies ---
         with profile("scf::potential"):
-            pot = generate_potential(ctx, rho_g, xc, mag_g)
+            pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
         if _cks.enabled():
             _cks.checksum("veff", pot.veff_g)
         scf_correction = (
@@ -845,7 +902,8 @@ def run_scf(
         eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
         e = pot.energies
         e_total = (
-            eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+            eval_sum - e["vxc"] - e["bxc"] - e.get("vtau_tau", 0.0)
+            - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
             + scf_correction + (e_hub - e_hub_one_el if hub is not None else 0.0)
             + (paw_res["e_total"] - e_paw_one_el if paw is not None else 0.0)
         )
@@ -902,7 +960,8 @@ def run_scf(
     e = pot.energies
     eval_sum = float(np.sum(ctx.kweights[:, None, None] * occ_np * evals))
     e_total = (
-        eval_sum - e["vxc"] - e["bxc"] - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
+        eval_sum - e["vxc"] - e["bxc"] - e.get("vtau_tau", 0.0)
+            - 0.5 * e["vha"] + e["exc"] + ctx.e_ewald
         + scf_correction + (e_hub - e_hub_one_el if hub is not None else 0.0)
         + (paw_res["e_total"] - e_paw_one_el if paw is not None else 0.0)
     )
@@ -921,7 +980,7 @@ def run_scf(
             "total": e_total,
             "free": e_total + float(entropy_sum),
             "eval_sum": eval_sum,
-            "kin": eval_sum - e["veff"] - e["bxc"],
+            "kin": eval_sum - e["veff"] - e["bxc"] - e.get("vtau_tau", 0.0),
             "veff": e["veff"],
             "vha": e["vha"],
             "vxc": e["vxc"],

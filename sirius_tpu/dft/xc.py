@@ -173,6 +173,110 @@ def _pbesol_c_e(nu, nd, suu, sud, sdd) -> jnp.ndarray:
     return _pbe_c_e(nu, nd, suu, sud, sdd, beta=_PBESOL_BETA)
 
 
+# ---------------------------------------------------------------------------
+# SCAN meta-GGA (Sun, Ruzsinszky, Perdew, PRL 115, 036402 (2015)).
+# Implemented as the ENERGY density only; v_rho / v_sigma / v_tau all come
+# from jax.grad — the TPU-native replacement for the reference's hand-coded
+# libxc mGGA surface (xc_functional_base.hpp:1043+). tau is the positive KS
+# kinetic-energy density (1/2) sum occ |grad psi|^2 per spin.
+
+_SCAN_K1 = 0.065
+_SCAN_MU = 10.0 / 81.0
+_SCAN_B2 = jnp.sqrt(5913.0 / 405000.0)
+_SCAN_B1 = (511.0 / 13500.0) / (2.0 * _SCAN_B2)
+_SCAN_B3 = 0.5
+_SCAN_B4 = _SCAN_MU**2 / _SCAN_K1 - 1606.0 / 18225.0 - _SCAN_B1**2
+_SCAN_H0X = 1.174
+_SCAN_A1 = 4.9479
+_SCAN_C1X, _SCAN_C2X, _SCAN_DX = 0.667, 0.8, 1.24
+_SCAN_C1C, _SCAN_C2C, _SCAN_DC = 0.64, 1.5, 0.7
+_SCAN_B1C, _SCAN_B2C, _SCAN_B3C = 0.0285764, 0.0889, 0.125541
+_SCAN_CHI = 0.12802585262625815
+_SCAN_GAMMA = 0.031091
+
+
+def _scan_interp(alpha, c1, c2, d):
+    """SCAN's alpha-interpolation f(alpha): exp(-c1 a/(1-a)) below a=1,
+    -d exp(c2/(1-a)) above; smooth and bounded with safe clamping (the
+    exact function hits exp(-inf)=0 at alpha=1 from both sides)."""
+    am1 = alpha - 1.0
+    lo = jnp.exp(-c1 * alpha / jnp.maximum(-am1, 1e-12))
+    hi = -d * jnp.exp(-c2 / jnp.maximum(am1, 1e-12))
+    return jnp.where(alpha < 1.0, lo, hi)
+
+
+def _scan_x_half(n2, sigma4, tau2):
+    """SCAN exchange per volume of one fully-polarized channel (2n, 4sigma,
+    2tau); spin-scaling Ex[nu,nd] = (Ex[2nu] + Ex[2nd])/2 by the caller."""
+    n2 = jnp.maximum(n2, _TINY)
+    kf = (3.0 * jnp.pi**2 * n2) ** (1.0 / 3.0)
+    ex_lda = -(3.0 / (4.0 * jnp.pi)) * kf * n2
+    s2 = sigma4 / jnp.maximum(4.0 * kf**2 * n2**2, _TINY)
+    s = jnp.sqrt(jnp.maximum(s2, _TINY))
+    tau_w = sigma4 / (8.0 * n2)
+    tau_u = 0.3 * (3.0 * jnp.pi**2) ** (2.0 / 3.0) * n2 ** (5.0 / 3.0)
+    alpha = jnp.maximum(tau2 - tau_w, 0.0) / jnp.maximum(tau_u, _TINY)
+    x = _SCAN_MU * s2 * (
+        1.0 + (_SCAN_B4 * s2 / _SCAN_MU) * jnp.exp(-jnp.abs(_SCAN_B4) * s2 / _SCAN_MU)
+    ) + (
+        _SCAN_B1 * s2 + _SCAN_B2 * (1.0 - alpha) * jnp.exp(-_SCAN_B3 * (1.0 - alpha) ** 2)
+    ) ** 2
+    h1x = 1.0 + _SCAN_K1 - _SCAN_K1 / (1.0 + x / _SCAN_K1)
+    fx = _scan_interp(alpha, _SCAN_C1X, _SCAN_C2X, _SCAN_DX)
+    gx = 1.0 - jnp.exp(-_SCAN_A1 / jnp.sqrt(s))
+    fx_tot = (h1x + fx * (_SCAN_H0X - h1x)) * gx
+    return ex_lda * fx_tot
+
+
+def _scan_x_e(nu, nd, suu, sud, sdd, tu, td):
+    return 0.5 * (
+        _scan_x_half(2 * nu, 4 * suu, 2 * tu)
+        + _scan_x_half(2 * nd, 4 * sdd, 2 * td)
+    )
+
+
+def _scan_c_e(nu, nd, suu, sud, sdd, tu, td):
+    n = jnp.maximum(nu + nd, _TINY)
+    zeta = jnp.clip((nu - nd) / n, -0.999999, 0.999999)
+    sigma = suu + 2.0 * sud + sdd
+    tau = tu + td
+    rs = (3.0 / (4.0 * jnp.pi * n)) ** (1.0 / 3.0)
+    kf = (3.0 * jnp.pi**2 * n) ** (1.0 / 3.0)
+    s2 = sigma / jnp.maximum(4.0 * kf**2 * n**2, _TINY)
+    s = jnp.sqrt(jnp.maximum(s2, _TINY))
+    ds = 0.5 * ((1.0 + zeta) ** (5.0 / 3.0) + (1.0 - zeta) ** (5.0 / 3.0))
+    tau_w = sigma / (8.0 * n)
+    tau_u = 0.3 * (3.0 * jnp.pi**2) ** (2.0 / 3.0) * n ** (5.0 / 3.0) * ds
+    alpha = jnp.maximum(tau - tau_w, 0.0) / jnp.maximum(tau_u, _TINY)
+    phi = 0.5 * ((1.0 + zeta) ** (2.0 / 3.0) + (1.0 - zeta) ** (2.0 / 3.0))
+
+    # eps_c^1: PW92 + H1 (PBE-like with rs-dependent beta)
+    eps_lsda = _lda_c_pw_e(nu, nd, mod=True) / n
+    beta_rs = 0.066725 * (1.0 + 0.1 * rs) / (1.0 + 0.1778 * rs)
+    t2 = (
+        (3.0 * jnp.pi**2 / 16.0) ** (2.0 / 3.0)
+        * s2
+        / jnp.maximum(phi**2 * rs, _TINY)
+    )
+    w1 = jnp.expm1(-eps_lsda / (_SCAN_GAMMA * phi**3))
+    y = beta_rs / (_SCAN_GAMMA * jnp.maximum(w1, _TINY)) * t2
+    gy = (1.0 + 4.0 * y) ** (-0.25)
+    h1 = _SCAN_GAMMA * phi**3 * jnp.log1p(w1 * (1.0 - gy))
+    eps1 = eps_lsda + h1
+
+    # eps_c^0: low-density limit + H0
+    eps_lda0 = -_SCAN_B1C / (1.0 + _SCAN_B2C * jnp.sqrt(rs) + _SCAN_B3C * rs)
+    w0 = jnp.expm1(-eps_lda0 / _SCAN_B1C)
+    ginf = (1.0 + 4.0 * _SCAN_CHI * s2) ** (-0.25)
+    h0 = _SCAN_B1C * jnp.log1p(w0 * (1.0 - ginf))
+    dxz = 0.5 * ((1.0 + zeta) ** (4.0 / 3.0) + (1.0 - zeta) ** (4.0 / 3.0))
+    gc = (1.0 - 2.3631 * (dxz - 1.0)) * (1.0 - zeta**12)
+    eps0 = (eps_lda0 + h0) * gc
+
+    fc = _scan_interp(alpha, _SCAN_C1C, _SCAN_C2C, _SCAN_DC)
+    return n * (eps1 + fc * (eps0 - eps1))
+
+
 _LDA_FUNCS = {
     "XC_LDA_X": _lda_x_e,
     "XC_LDA_C_PZ": _lda_c_pz_e,
@@ -184,6 +288,10 @@ _GGA_FUNCS = {
     "XC_GGA_C_PBE": _pbe_c_e,
     "XC_GGA_X_PBE_SOL": _pbesol_x_e,
     "XC_GGA_C_PBE_SOL": _pbesol_c_e,
+}
+_MGGA_FUNCS = {
+    "XC_MGGA_X_SCAN": _scan_x_e,
+    "XC_MGGA_C_SCAN": _scan_c_e,
 }
 
 
@@ -198,51 +306,76 @@ class XCFunctional:
     """
 
     def __init__(self, names: list[str]):
-        unknown = [n for n in names if n not in _LDA_FUNCS and n not in _GGA_FUNCS]
+        unknown = [
+            n for n in names
+            if n not in _LDA_FUNCS and n not in _GGA_FUNCS
+            and n not in _MGGA_FUNCS
+        ]
         if unknown:
             raise ValueError(f"unsupported xc functional(s): {unknown}")
         self.names = list(names)
-        self.is_gga = any(n in _GGA_FUNCS for n in names)
+        self.is_mgga = any(n in _MGGA_FUNCS for n in names)
+        # mGGA needs the full gradient machinery too
+        self.is_gga = self.is_mgga or any(n in _GGA_FUNCS for n in names)
 
-    def _energy(self, nu, nd, suu, sud, sdd):
+    def _energy(self, nu, nd, suu, sud, sdd, tu, td):
         nu = jnp.maximum(nu, _TINY)
         nd = jnp.maximum(nd, _TINY)
         e = jnp.zeros_like(nu)
         for name in self.names:
             if name in _LDA_FUNCS:
                 e = e + _LDA_FUNCS[name](nu, nd)
-            else:
+            elif name in _GGA_FUNCS:
                 e = e + _GGA_FUNCS[name](nu, nd, suu, sud, sdd)
+            else:
+                e = e + _MGGA_FUNCS[name](nu, nd, suu, sud, sdd, tu, td)
         return e
 
-    def _eval(self, nu, nd, suu, sud, sdd):
+    def _eval(self, nu, nd, suu, sud, sdd, tu, td):
         grads = jax.grad(
-            lambda a, b, c, d, f: jnp.sum(self._energy(a, b, c, d, f)),
-            argnums=(0, 1, 2, 3, 4),
+            lambda a, b, c, d, f, g, h: jnp.sum(
+                self._energy(a, b, c, d, f, g, h)
+            ),
+            argnums=(0, 1, 2, 3, 4, 5, 6),
         )
-        vu, vd, vsuu, vsud, vsdd = grads(nu, nd, suu, sud, sdd)
-        return self._energy(nu, nd, suu, sud, sdd), vu, vd, vsuu, vsud, vsdd
+        vu, vd, vsuu, vsud, vsdd, vtu, vtd = grads(nu, nd, suu, sud, sdd, tu, td)
+        return (
+            self._energy(nu, nd, suu, sud, sdd, tu, td),
+            vu, vd, vsuu, vsud, vsdd, vtu, vtd,
+        )
 
-    def evaluate_polarized(self, rho_up, rho_dn, sigma_uu=None, sigma_ud=None, sigma_dd=None):
+    def evaluate_polarized(self, rho_up, rho_dn, sigma_uu=None, sigma_ud=None,
+                           sigma_dd=None, tau_up=None, tau_dn=None):
         z = jnp.zeros_like(rho_up)
-        e, vu, vd, vsuu, vsud, vsdd = self._eval(
+        e, vu, vd, vsuu, vsud, vsdd, vtu, vtd = self._eval(
             rho_up, rho_dn,
             z if sigma_uu is None else sigma_uu,
             z if sigma_ud is None else sigma_ud,
             z if sigma_dd is None else sigma_dd,
+            z if tau_up is None else tau_up,
+            z if tau_dn is None else tau_dn,
         )
         out = {"e": e, "v_up": vu, "v_dn": vd}
         if self.is_gga:
             out.update(vsigma_uu=vsuu, vsigma_ud=vsud, vsigma_dd=vsdd)
+        if self.is_mgga:
+            out.update(vtau_up=vtu, vtau_dn=vtd)
         return out
 
-    def evaluate(self, rho, sigma=None):
-        """Unpolarized: rho is the total density, sigma = |grad rho|^2.
-        Returns e (per volume), v = de/drho, and vsigma = de/dsigma."""
+    def evaluate(self, rho, sigma=None, tau=None):
+        """Unpolarized: rho is the total density, sigma = |grad rho|^2,
+        tau the total positive KS kinetic-energy density. Returns e (per
+        volume), v = de/drho, vsigma = de/dsigma, vtau = de/dtau."""
         half = 0.5 * rho
-        s4 = jnp.zeros_like(rho) if sigma is None else 0.25 * sigma
-        e, vu, vd, vsuu, vsud, vsdd = self._eval(half, half, s4, s4, s4)
+        z = jnp.zeros_like(rho)
+        s4 = z if sigma is None else 0.25 * sigma
+        t2 = z if tau is None else 0.5 * tau
+        e, vu, vd, vsuu, vsud, vsdd, vtu, vtd = self._eval(
+            half, half, s4, s4, s4, t2, t2
+        )
         out = {"e": e, "v": 0.5 * (vu + vd)}
         if self.is_gga:
             out["vsigma"] = 0.25 * (vsuu + vsud + vsdd)
+        if self.is_mgga:
+            out["vtau"] = 0.5 * (vtu + vtd)
         return out

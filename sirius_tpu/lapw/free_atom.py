@@ -136,8 +136,6 @@ def solve_free_atom(zn: int, xc_names=("XC_LDA_X", "XC_LDA_C_VWN"),
     energy_components}. rho is the per-volume density (integrates to zn
     with the 4 pi r^2 measure). Reference: apps/atoms/atom.cpp scf loop.
     """
-    import jax
-
     from sirius_tpu.core.radial import Spline, spline_quadrature_weights
     from sirius_tpu.dft.xc import XCFunctional
     from sirius_tpu.lapw.radial_solver import (
@@ -178,7 +176,7 @@ def solve_free_atom(zn: int, xc_names=("XC_LDA_X", "XC_LDA_C_VWN"),
     levels = []
     for it in range(max_iter):
         vh = _hartree_radial(r, rho)
-        exc_e, vxc = xc_eval(rho)
+        _, vxc = xc_eval(rho)
         veff = vh + vxc - zn / r
         rho_new = np.zeros_like(r)
         esum = 0.0
@@ -209,9 +207,7 @@ def solve_free_atom(zn: int, xc_names=("XC_LDA_X", "XC_LDA_C_VWN"),
         vh_n = _hartree_radial(r, rho_new)
         exc_n, vxc_n = xc_eval(rho_new)
         e_h = 0.5 * rint(rho_new * vh_n)
-        e_xc = rint(exc_n / np.maximum(rho_new, 1e-30) * rho_new)
-        # exc_e is energy PER VOLUME already
-        e_xc = 4.0 * np.pi * float(np.sum(w * exc_n * r * r))
+        e_xc = rint(exc_n)  # exc_n is the energy PER VOLUME
         e_tot = (
             esum
             - rint(rho_new * (vh + vxc))
@@ -225,7 +221,7 @@ def solve_free_atom(zn: int, xc_names=("XC_LDA_X", "XC_LDA_C_VWN"),
             rho = rho_new
             break
     vh = _hartree_radial(r, rho)
-    exc_e, vxc = xc_eval(rho)
+    _, vxc = xc_eval(rho)
     veff = vh + vxc - zn / r
     return {
         "r": r,

@@ -1,0 +1,145 @@
+"""SCAN meta-GGA: functional limits, tau machinery, operator identity.
+
+Reference counterpart: the libxc mGGA surface (xc_functional_base.hpp) and
+the tau term of the KS operator. SCAN's exact constraints give free
+validation points: at s = 0 and alpha = 1 it reduces EXACTLY to LSDA
+(PW92-mod correlation), and a constant v_tau makes the tau operator a
+scaled kinetic operator."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from sirius_tpu.dft.xc import XCFunctional, _lda_c_pw_e, _lda_x_e
+from tests.conftest import requires_reference
+
+
+def test_scan_uniform_gas_reduces_to_lsda():
+    rng = np.random.default_rng(7)
+    n = jnp.asarray(rng.uniform(0.01, 2.0, 40))
+    zeta = jnp.asarray(rng.uniform(-0.9, 0.9, 40))
+    nu = 0.5 * n * (1 + zeta)
+    nd = 0.5 * n * (1 - zeta)
+    # per-spin uniform-gas kinetic density: alpha = 1 in both channels
+    tfac = 0.3 * (6.0 * np.pi**2) ** (2.0 / 3.0)
+    tu = tfac * nu ** (5.0 / 3.0)
+    td = tfac * nd ** (5.0 / 3.0)
+    z = jnp.zeros_like(n)
+    scan = XCFunctional(["XC_MGGA_X_SCAN", "XC_MGGA_C_SCAN"])
+    e = np.asarray(scan._energy(nu, nd, z, z, z, tu, td))
+    e_lsda = np.asarray(_lda_x_e(nu, nd) + _lda_c_pw_e(nu, nd, mod=True))
+    np.testing.assert_allclose(e, e_lsda, rtol=2e-6)
+
+
+def test_scan_potentials_finite():
+    """Autodiff potentials stay finite over a wide (n, s, alpha) range
+    including the alpha ~ 1 interpolation boundary."""
+    rng = np.random.default_rng(3)
+    m = 200
+    nu = jnp.asarray(rng.uniform(1e-6, 5.0, m))
+    nd = jnp.asarray(rng.uniform(1e-6, 5.0, m))
+    suu = jnp.asarray(rng.uniform(0.0, 10.0, m))
+    sdd = jnp.asarray(rng.uniform(0.0, 10.0, m))
+    sud = jnp.sqrt(suu * sdd) * 0.5
+    tu = jnp.asarray(rng.uniform(1e-8, 20.0, m))
+    td = jnp.asarray(rng.uniform(1e-8, 20.0, m))
+    scan = XCFunctional(["XC_MGGA_X_SCAN", "XC_MGGA_C_SCAN"])
+    out = scan.evaluate_polarized(nu, nd, suu, sud, sdd, tau_up=tu, tau_dn=td)
+    for k in ("e", "v_up", "v_dn", "vsigma_uu", "vtau_up", "vtau_dn"):
+        assert np.all(np.isfinite(np.asarray(out[k]))), k
+    # exchange energy must be negative
+    xonly = XCFunctional(["XC_MGGA_X_SCAN"])
+    ex = np.asarray(xonly._energy(nu, nd, suu, sud, sdd, tu, td))
+    assert np.all(ex < 0)
+
+
+def _si_params():
+    from sirius_tpu.parallel.batched import (
+        hk_complex,
+        hkset_slice_r,
+        make_hkset_params,
+    )
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(
+        gk_cutoff=4.0, pw_cutoff=12.0, ngridk=(1, 1, 1), num_bands=6,
+        use_symmetry=False,
+    )
+    params = make_hkset_params(ctx, np.full(ctx.fft_coarse.dims, 0.05))
+    return ctx, params
+
+
+def test_constant_vtau_is_scaled_kinetic():
+    """-1/2 div(c grad psi) = c * (-1/2 laplacian psi): with v_tau = c the
+    tau operator must equal c x the kinetic diagonal exactly."""
+    from sirius_tpu.ops.hamiltonian import apply_h_s
+    from sirius_tpu.ops.mgga import apply_h_s_mgga
+    from sirius_tpu.parallel.batched import hk_complex, hkset_slice_r
+
+    ctx, params = _si_params()
+    slc = hkset_slice_r(params)
+    pk = hk_complex({k: (None if v is None else jnp.asarray(v)) for k, v in slc.items()})
+    rng = np.random.default_rng(0)
+    ngk = ctx.gkvec.ngk_max
+    psi = (
+        rng.standard_normal((4, ngk)) + 1j * rng.standard_normal((4, ngk))
+    ) * np.asarray(ctx.gkvec.mask[0])
+    psi = jnp.asarray(psi)
+    c = 0.37
+    vtau = jnp.full(ctx.fft_coarse.dims, c)
+    gkc = jnp.asarray(ctx.gkvec.gkcart[0])
+    h0, s0 = apply_h_s(pk, psi)
+    h1, s1 = apply_h_s_mgga(pk, vtau, gkc, psi)
+    ekin = np.asarray(ctx.gkvec.kinetic()[0])
+    expect = np.asarray(h0) + c * ekin * np.asarray(psi)
+    np.testing.assert_allclose(np.asarray(h1), expect, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), atol=1e-14)
+
+
+def test_tau_integral_is_kinetic_energy():
+    """Omega * tau_g(G=0) = sum occ <psi|-1/2 lap|psi> (Parseval)."""
+    from sirius_tpu.dft.density import density_from_coarse_acc
+    from sirius_tpu.ops.mgga import tau_kset
+
+    ctx, params = _si_params()
+    rng = np.random.default_rng(1)
+    ngk = ctx.gkvec.ngk_max
+    psi = (
+        rng.standard_normal((1, 1, 4, ngk)) + 1j * rng.standard_normal((1, 1, 4, ngk))
+    ) * np.asarray(ctx.gkvec.mask)[:, None, None, :]
+    occ_w = np.array([[[2.0, 2.0, 1.0, 0.5]]])
+    acc = np.asarray(tau_kset(
+        params.fft_index, jnp.asarray(ctx.gkvec.gkcart),
+        jnp.asarray(np.real(psi)), jnp.asarray(np.imag(psi)),
+        jnp.asarray(occ_w), tuple(ctx.fft_coarse.dims),
+    ))
+    tau_g = density_from_coarse_acc(ctx, acc)
+    ekin = np.asarray(ctx.gkvec.kinetic())  # [nk, ngk]
+    t_direct = float(np.sum(occ_w[0, 0][:, None] * ekin[0] * np.abs(psi[0, 0]) ** 2))
+    t_tau = float(np.real(tau_g[0, 0]) * ctx.unit_cell.omega)
+    np.testing.assert_allclose(t_tau, t_direct, rtol=1e-10)
+
+
+@requires_reference
+def test_scan_scf_smoke():
+    """A few SCF iterations of Si with SCAN run finite and settle."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import json
+    import warnings
+
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.dft.scf import run_scf
+
+    base = "/root/reference/verification/test08"
+    cfg = load_config(base + "/sirius.json")
+    cfg.parameters.xc_functionals = ["XC_MGGA_X_SCAN", "XC_MGGA_C_SCAN"]
+    cfg.parameters.num_dft_iter = 5
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = run_scf(cfg, base_dir=base)
+    hist = res["etot_history"]
+    assert np.all(np.isfinite(hist))
+    assert abs(hist[-1] - hist[-2]) < 0.05 * abs(hist[1] - hist[0]) + 1e-3
