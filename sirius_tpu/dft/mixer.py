@@ -34,6 +34,8 @@ class Mixer:
         num_components: int = 1,
         extra_len: int = 0,
         omega: float | None = None,
+        weight: np.ndarray | None = None,
+        rms_weight: np.ndarray | None = None,
     ):
         """num_components: G-sized components (charge first, then
         magnetization); extra_len: trailing flat entries (occupation/density
@@ -86,6 +88,13 @@ class Mixer:
                 [rms_charge]
                 + [np.ones(ng)] * (num_components - 1)
                 + [np.zeros(extra_len)]
+            )
+        if weight is not None:
+            # explicit metric (FP-LAPW mixed vector: real integration
+            # measures per coefficient instead of the G-space construction)
+            self.weight = np.asarray(weight)
+            self.rms_weight = (
+                self.weight if rms_weight is None else np.asarray(rms_weight)
             )
         self._x: list[np.ndarray] = []  # input history
         self._f: list[np.ndarray] = []  # residual history f = x_out - x_in

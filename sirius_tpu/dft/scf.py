@@ -792,8 +792,20 @@ def run_scf(
                         ps.fft_index, _gkc_dev(rdt), pr, pi, occ_w,
                         tuple(ctx.fft_coarse.dims),
                     ))
-                    # same 1/Omega + coarse->fine mapping as the density
+                    # same 1/Omega + coarse->fine mapping as the density;
+                    # tau transforms as a scalar field, so the reduced
+                    # k-wedge sum needs the same point-group symmetrization
+                    # as rho
                     tau_g = density_from_coarse_acc(ctx, tau_acc)
+                    if do_symmetrize:
+                        tau_g = np.stack(
+                            [symmetrize_pw(ctx, t) for t in tau_g]
+                        )
+                    # NOTE: the potential is built from the MIXED density
+                    # but the FRESH tau of the current wave functions (tau
+                    # is psi-derived and not part of the mixing vector);
+                    # near self-consistency the pair is consistent, and the
+                    # SCAN smoke test covers the transient
         dm_blocks_by_spin = []
         if ctx.aug is not None:
             from sirius_tpu.dft.density import symmetrize_density_matrix
@@ -1054,6 +1066,11 @@ def run_scf(
     if cfg.control.print_stress and num_iter_done > 0:
         from sirius_tpu.dft.stress import StressCalculator
 
+        if mgga:
+            # StressCalculator evaluates the XC functional without tau and
+            # the tau-operator stress term is not implemented: computing a
+            # plausibly-sized wrong tensor silently is worse than refusing
+            raise NotImplementedError("stress with mGGA is not implemented")
         calc = StressCalculator(ctx, xc)
         sterms = calc.compute(
             rho_g, mag_g, rho_r,
@@ -1142,6 +1159,10 @@ def run_scf_from_file(
         from sirius_tpu.dft.bands import band_path, sample_path
         from sirius_tpu.dft.xc import XCFunctional
 
+        if XCFunctional(cfg.parameters.xc_functionals).is_mgga:
+            # the saved state carries no tau and band_path applies the
+            # tau-less operator; fail BEFORE the (long) SCF, not after
+            raise NotImplementedError("k_point_path with mGGA")
         # vk defines the band path, NOT the SCF mesh (reference task
         # semantics: SCF on ngridk, then bands along vk)
         vk_path = list(cfg.parameters.vk)

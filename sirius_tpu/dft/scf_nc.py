@@ -100,6 +100,10 @@ def run_scf_nc(
         ctx = SimulationContext.create(cfg, base_dir)
     assert ctx.num_mag_dims == 3
     xc = XCFunctional(p.xc_functionals)
+    if xc.is_mgga:
+        # evaluate_polarized would silently default tau to zero and the
+        # spinor apply has no tau operator
+        raise NotImplementedError("mGGA with non-collinear magnetism")
     nk, nb = ctx.gkvec.num_kpoints, ctx.num_bands
     nel = ctx.unit_cell.num_valence_electrons - p.extra_charge
     if nb * ctx.max_occupancy < nel - 1e-12:

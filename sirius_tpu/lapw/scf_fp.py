@@ -381,7 +381,23 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 off += sz
         return ig, mts, mig, mmts
 
-    mixer = Mixer(cfg.mixer)
+    # FP mixing metric: real integration measures per packed coefficient —
+    # interstitial plane-wave coefficients carry Omega, MT (lm, r) entries
+    # carry the radial quadrature w_j r_j^2 (the reference mixes FP
+    # Periodic_functions with their true inner products, mixer_functions.cpp
+    # periodic_function_property; a plain l2 over the packed vector lets the
+    # ~10^5 MT coefficients drown the interstitial ones and destabilizes
+    # the Anderson geometry — Fe test19 loses its moment at beta = 0.5)
+    _wig = np.full(2 * ctx.gvec.num_gvec, ctx.omega)
+    _wmt = []
+    for sp in ctx.species_of_atom:
+        wr = radial_weights(sp.r) * sp.r**2
+        _wmt.append(
+            np.broadcast_to(wr, (num_lm(ctx.lmax_rho), sp.nrmt)).ravel()
+        )
+    _wparts = [_wig] + ([_wig] if nm else []) + _wmt + (_wmt if nm else [])
+    _w = np.concatenate(_wparts)
+    mixer = Mixer(cfg.mixer, weight=_w, rms_weight=_w / ctx.omega)
     n = np.prod(ctx.dims)
     etot_history, rms_history = [], []
     e = {}

@@ -187,7 +187,17 @@ class SpinOrbitData:
         from sirius_tpu.ops.spinor import spin_blocks_from_components
 
         out = np.asarray(spin_blocks_from_components(d0, db[2], db[0], db[1]))
-        s_idx = [[0, 3], [2, 1]]
+        # storage map for the (sigma, sigma') element in OUR (uu, dd, ud,
+        # du) slot order: (0,1) -> ud=2, (1,0) -> du=3. NOTE this is the
+        # TRANSPOSE of the reference's s_idx {{0,3},{2,1}}: with this
+        # package's f convention (Hermitian projector f[m1,m2,s,s'] =
+        # <m1 s|P_lj|m2 s'>) the congruence below yields the (sigma,
+        # sigma') element directly, while the reference's f is transposed
+        # in its spin slots and compensates inside its own apply. The
+        # degenerate-j completeness test pins the correct mapping: only
+        # the antisymmetric Pauli-y channel can tell the two apart, which
+        # is why it survived until the sigma.B reduction test existed.
+        s_idx = [[0, 2], [3, 1]]
         for ia, off, nbf, it in self._iter():
             f = self.frf_by_type[it]
             if f is None:

@@ -116,3 +116,65 @@ def test_so_d_eigenspaces_have_pure_j_character(au):
         got = np.real(np.trace(sub.conj().T @ j2 @ sub) / len(idx))
         j = jval_by_dion[val]
         assert abs(got - j * (j + 1)) < 1e-8, (val, j, got)
+
+
+def test_degenerate_j_reduces_to_plain_sigma_b():
+    """Completeness check of the FULL Eq. 19 congruence: when both
+    j = l +- 1/2 channels share one radial function and one dion value,
+    sum_j P_lj = identity and the SO D spin blocks, contracted over the
+    duplicated radial structure, must equal the plain sigma.B assembly
+    (spin_blocks_from_components) exactly — for arbitrary augmentation
+    and B-field integrals. A transpose or spin-index-order bug anywhere in
+    the PAULI congruence or s_idx mapping breaks this identity."""
+    from sirius_tpu.ops.so import SpinOrbitData, f_coefficients
+    from sirius_tpu.ops.spinor import spin_blocks_from_components
+
+    class B:
+        def __init__(self, l, j):
+            self.l, self.j = l, j
+
+    class T:
+        spin_orbit = True
+        beta = [B(1, 0.5), B(1, 1.5)]  # same l, both j, SAME radial content
+        d_ion = np.array([[0.7, 0.0], [0.0, 0.7]])
+
+    t = T()
+    f = f_coefficients(t)
+    nm = 3  # 2l+1
+    nbf = 2 * nm
+    meta = [(ib, b.l, b.j) for ib, b in enumerate(t.beta) for _ in range(2 * b.l + 1)]
+    same_rf = np.array([[a[0] == b_[0] for b_ in meta] for a in meta])
+    same_lj = np.array([[a[1:] == b_[1:] for b_ in meta] for a in meta])
+    rf = np.asarray([m[0] for m in meta])
+    so = SpinOrbitData(
+        f_by_type=[f],
+        frf_by_type=[f * same_rf[:, :, None, None]],
+        dion_xi=[t.d_ion[np.ix_(rf, rf)] * same_lj],
+        dion_collinear=[np.zeros((nbf, nbf))],
+        qxi_by_type=[None],
+        blocks=[(0, 0, nbf)],
+        type_of_atom=np.array([0]),
+    )
+    rng = np.random.default_rng(5)
+
+    def sym(n):
+        a = rng.standard_normal((n, n))
+        return 0.5 * (a + a.T)
+
+    # plain-basis integrals [nm, nm]; duplicated over the two j radials
+    a_plain = sym(nm)
+    b_plain = [sym(nm) for _ in range(3)]  # Bx, By, Bz
+    a_dup = np.kron(np.ones((2, 2)), a_plain)
+    b_dup = [np.kron(np.ones((2, 2)), b) for b in b_plain]
+    # d0 = screened scalar D = dion_collinear (zero here) + aug part
+    out = so.d_blocks(a_dup, b_dup)
+    # contract the duplicated radial structure back to the plain basis
+    eff = out.reshape(4, 2, nm, 2, nm).sum(axis=(1, 3))
+    plain = spin_blocks_from_components(
+        a_plain, b_plain[2], b_plain[0], b_plain[1]
+    )
+    # ionic part: the degenerate dion (0.7 on both j radials) contracts by
+    # completeness (sum_j P_lj = 1) to 0.7 delta_{m1 m2} delta_{s s'}
+    plain[0] += 0.7 * np.eye(nm)
+    plain[1] += 0.7 * np.eye(nm)
+    np.testing.assert_allclose(eff, plain, atol=1e-12)
