@@ -871,6 +871,58 @@ void sirius_linear_solver(void* handler, double const* vkq, int const* num_gvec_
     PyGILState_Release(st);
 }
 
+/* ---- DFPT helpers (reference sirius_generate_rhoaug_q
+ * sirius_api.cpp:6337, sirius_generate_d_operator_matrix, sirius_nlcg):
+ * the linear-response entries QE's phonon/nlcg hosts drive ---- */
+
+void sirius_generate_rhoaug_q(void* const* gs_handler, int const* iat, int const* num_atoms,
+                              int const* num_gvec_loc, int const* num_spin_comp,
+                              double const* qpw /* complex */, int const* ldq,
+                              double const* phase_factors_q /* complex, num_atoms */,
+                              int const* mill /* 3 x num_gvec_loc */,
+                              double const* dens_mtrx /* complex */, int const* ldd,
+                              double* rho_aug /* complex, num_gvec_loc x nsp */,
+                              int* error_code)
+{
+    std::lock_guard<std::mutex> lk(g_mutex);
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_ssize_t ngv = *num_gvec_loc;
+    PyObject* b_q = PyBytes_FromStringAndSize(reinterpret_cast<char const*>(qpw),
+                                              (Py_ssize_t)(*ldq) * ngv * 16);
+    PyObject* b_ph = PyBytes_FromStringAndSize(reinterpret_cast<char const*>(phase_factors_q),
+                                               (Py_ssize_t)(*num_atoms) * 16);
+    PyObject* b_mill = PyBytes_FromStringAndSize(reinterpret_cast<char const*>(mill),
+                                                 3 * ngv * (Py_ssize_t)sizeof(int));
+    PyObject* b_dm = PyBytes_FromStringAndSize(reinterpret_cast<char const*>(dens_mtrx),
+                                               (Py_ssize_t)(*ldd) * (*num_atoms) * (*num_spin_comp) * 16);
+    PyObject* b_out = PyBytes_FromStringAndSize(reinterpret_cast<char*>(rho_aug),
+                                                ngv * (Py_ssize_t)(*num_spin_comp) * 16);
+    PyObject* r = call("generate_rhoaug_q_bytes",
+                       Py_BuildValue("(liiiiOiOOOiO)", reinterpret_cast<long>(*gs_handler),
+                                     *iat, *num_atoms, *num_gvec_loc, *num_spin_comp,
+                                     b_q, *ldq, b_ph, b_mill, b_dm, *ldd, b_out));
+    Py_XDECREF(b_q); Py_XDECREF(b_ph); Py_XDECREF(b_mill);
+    Py_XDECREF(b_dm); Py_XDECREF(b_out);
+    if (r && PyBytes_Check(r)) {
+        std::memcpy(rho_aug, PyBytes_AsString(r), (size_t)PyBytes_Size(r));
+        set_err(error_code, 0);
+    } else {
+        set_err(error_code, 1);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(st);
+}
+
+void sirius_generate_d_operator_matrix(void* const* handler, int* error_code)
+{
+    call_void_h("generate_d_operator_matrix", *handler, error_code);
+}
+
+void sirius_nlcg(void* const* handler, int* error_code)
+{
+    call_void_h("nlcg", *handler, error_code);
+}
+
 /* ---- host callbacks (reference sirius_set_callback_function): the
  * pointers are registered and invoked from the python side through
  * ctypes when the matching radial-integral path runs ---- */

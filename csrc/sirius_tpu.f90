@@ -253,5 +253,34 @@ module sirius_tpu
             character(kind=c_char), dimension(*), intent(in) :: file_name
             integer(c_int), intent(out) :: error_code
         end subroutine
+
+        subroutine sirius_generate_rhoaug_q(gs_handler, iat, num_atoms, &
+                num_gvec_loc, num_spin_comp, qpw, ldq, phase_factors_q, &
+                mill, dens_mtrx, ldd, rho_aug, error_code) &
+                bind(C, name="sirius_generate_rhoaug_q")
+            import :: c_ptr, c_int, c_double
+            type(c_ptr), intent(in) :: gs_handler
+            integer(c_int), intent(in) :: iat, num_atoms, num_gvec_loc
+            integer(c_int), intent(in) :: num_spin_comp, ldq, ldd
+            complex(8), dimension(*), intent(in) :: qpw, phase_factors_q
+            complex(8), dimension(*), intent(in) :: dens_mtrx
+            integer(c_int), dimension(*), intent(in) :: mill
+            complex(8), dimension(*), intent(inout) :: rho_aug
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_generate_d_operator_matrix(handler, error_code) &
+                bind(C, name="sirius_generate_d_operator_matrix")
+            import :: c_ptr, c_int
+            type(c_ptr), intent(in) :: handler
+            integer(c_int), intent(out) :: error_code
+        end subroutine
+
+        subroutine sirius_nlcg(handler, error_code) &
+                bind(C, name="sirius_nlcg")
+            import :: c_ptr, c_int
+            type(c_ptr), intent(in) :: handler
+            integer(c_int), intent(out) :: error_code
+        end subroutine
     end interface
 end module sirius_tpu

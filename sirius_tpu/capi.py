@@ -592,6 +592,84 @@ def linear_solver_bytes(h: int, vkq, dpsi: bytes, psi: bytes, eigvals: bytes,
     return np.asfortranarray(dp).tobytes(order="F")
 
 
+# ---- DFPT helpers (reference sirius_generate_rhoaug_q,
+# sirius_api.cpp:6279, and sirius_generate_d_operator_matrix) — the
+# linear-response hooks QE's phonon code drives ----
+
+
+def generate_rhoaug_q_bytes(h: int, iat: int, num_atoms: int,
+                            num_gvec_loc: int, num_spin_comp: int,
+                            qpw: bytes, ldq: int, phase_factors_q: bytes,
+                            mill: bytes, dens_mtrx: bytes, ldd: int,
+                            rho_aug: bytes) -> bytes:
+    """Augmentation charge for a complex (q-shifted) density matrix:
+    rho_aug(G, s) += 2 sum_ia (sum_j dm_j(ia, s) qpw_j(G)) e^{i q r_ia}
+    conj(e^{i G r_ia}) over the atoms of type iat, with qpw the packed
+    upper-triangular Q(G) table supplied by the caller (reference
+    sirius_api.cpp:6337-6400 semantics, 1-based iat)."""
+    import numpy as np
+
+    st = _stepper(h)
+    uc = st.ctx.unit_cell
+    it = int(iat) - 1
+    atoms = [ia for ia in range(uc.num_atoms) if uc.type_of_atom[ia] == it]
+    q = np.frombuffer(qpw, dtype=np.complex128).reshape(
+        int(ldq), int(num_gvec_loc), order="F"
+    )
+    ph_q = np.frombuffer(phase_factors_q, dtype=np.complex128)
+    mi = np.frombuffer(mill, dtype=np.int32).reshape(
+        3, int(num_gvec_loc), order="F"
+    )
+    dm = np.frombuffer(dens_mtrx, dtype=np.complex128).reshape(
+        int(ldd), int(num_atoms), int(num_spin_comp), order="F"
+    )
+    out = np.frombuffer(rho_aug, dtype=np.complex128).reshape(
+        int(num_gvec_loc), int(num_spin_comp), order="F"
+    ).copy()
+    # nbeta(nbeta+1)/2 packed rows actually used for this type
+    t = uc.atom_types[it]
+    nb = sum(2 * b.l + 1 for b in t.beta)
+    npacked = nb * (nb + 1) // 2
+    # atom phase conj(e^{i G r_ia}) on the caller's Miller set
+    pos = np.asarray([uc.positions[ia] for ia in atoms])  # fractional
+    gdotr = 2.0 * np.pi * (mi.T @ pos.T)  # [ngv, natoms_of_type]
+    phase = np.exp(-1j * gdotr)  # conj(e^{+i G r})
+    for s in range(int(num_spin_comp)):
+        dmt = np.stack([dm[:npacked, ia, s] for ia in atoms])  # [na_t, np]
+        tmp = dmt @ q[:npacked]  # [na_t, ngv]
+        z = np.einsum(
+            "ag,a,ga->g", tmp, np.asarray([ph_q[ia] for ia in atoms]), phase
+        )
+        out[:, s] += 2.0 * z
+    return np.asfortranarray(out).tobytes(order="F")
+
+
+def generate_d_operator_matrix(h: int) -> None:
+    """Regenerate the screened D operator from the CURRENT effective
+    potential (reference sirius_generate_d_operator_matrix). The stepper
+    rebuilds D from pot inside every find_eigen_states, so this entry
+    validates the potential is in place and exercises the same kernel —
+    errors surface here instead of mid-solve."""
+    st = _stepper(h)
+    if st.pot is None:
+        raise RuntimeError("generate_effective_potential has not been called")
+    st._d_by_spin()
+
+
+def nlcg(h: int) -> None:
+    """Robust direct minimization of the current context's ground state
+    (reference sirius_nlcg — the nlcglib hook; here backed by
+    dft/direct_min.run_direct_min). Stores the result like
+    find_ground_state."""
+    _ensure_cpu_backend()
+    from sirius_tpu.config.schema import load_config
+
+    rec = _handles[int(h)]
+    from sirius_tpu.dft.direct_min import run_direct_min
+
+    rec["result"] = run_direct_min(load_config(rec["cfg"]), rec["base_dir"])
+
+
 # ---- host callbacks (reference sirius_set_callback_function +
 # callback_functions_t, simulation_context.hpp:64-102). Pointers are
 # invoked through ctypes; the supported hooks are consulted by the

@@ -1,0 +1,155 @@
+"""Matrix-free fv operator == dense assembly (lapw/fv_iter.py vs fv.py).
+
+The dense assemble_fv is the verification fallback for the iterative path
+(reference diagonalize_fp.hpp:271 apply_fv_h_o vs the exact solver): on the
+same inputs — including local orbitals and a non-spherical MT potential —
+H x and O x from the matrix-free apply must match the dense matrices, and
+the davidson solve must reproduce the dense eigenvalues."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from sirius_tpu.lapw.basis import build_radial_basis
+from sirius_tpu.lapw.fv import assemble_fv, diagonalize_fv
+from sirius_tpu.lapw.fv_iter import apply_fv_h_o, build_fv_params, davidson_fv
+from sirius_tpu.lapw.species import step_function_g
+
+
+class _Sp:
+    """Fake species: finite spherical well with one s local orbital."""
+
+    def __init__(self, rmt=2.0, nrmt=500):
+        self.rmt = rmt
+        self.r = 1e-6 * (rmt / 1e-6) ** (np.arange(nrmt) / (nrmt - 1.0))
+
+        class LoB:
+            def __init__(self, n, dme):
+                self.n, self.dme, self.auto, self.enu = n, dme, 0, -0.1
+
+        class Lo:
+            l = 0
+            basis = [LoB(1, 0), LoB(1, 1)]
+
+        self.lo = [Lo()]
+
+    def aw_basis(self, l):
+        class E:
+            enu = 0.2
+            auto = 0
+            dme = 0
+            n = 0
+
+        return [E(), E()]
+
+
+def _setup():
+    a = 6.0
+    lattice = np.eye(3) * a
+    omega = a**3
+    rmt = 2.0
+    lmax = 4
+    sp = _Sp(rmt=rmt)
+    vsph = -0.4 * np.exp(-sp.r)  # non-trivial spherical potential
+    basis = build_radial_basis(sp, vsph, lmax)
+
+    recip = 2.0 * np.pi * np.linalg.inv(lattice).T
+    nmax = 3
+    rng_i = np.arange(-nmax, nmax + 1)
+    mi, mj, mk = np.meshgrid(rng_i, rng_i, rng_i, indexing="ij")
+    mill = np.stack([mi.ravel(), mj.ravel(), mk.ravel()], axis=1)
+    keep = np.linalg.norm(mill @ recip, axis=1) <= 2.8
+    mill = mill[keep]
+
+    dims = (24, 24, 24)
+    fi, fj, fk = np.meshgrid(
+        np.fft.fftfreq(dims[0], 1 / dims[0]).astype(int),
+        np.fft.fftfreq(dims[1], 1 / dims[1]).astype(int),
+        np.fft.fftfreq(dims[2], 1 / dims[2]).astype(int),
+        indexing="ij",
+    )
+    mill_fine = np.stack([fi.ravel(), fj.ravel(), fk.ravel()], axis=1)
+    pos = np.array([[0.1, 0.0, 0.2]])
+    theta_g = step_function_g(
+        lattice, pos, np.array([rmt]), mill_fine @ recip, mill_fine
+    ).reshape(dims)
+    n = dims[0] * dims[1] * dims[2]
+    theta_r = np.real(np.fft.ifftn(theta_g) * n)
+
+    rng = np.random.default_rng(3)
+    # smooth random interstitial potential (few low-G components, real)
+    vg = np.zeros(dims, dtype=np.complex128)
+    for _ in range(6):
+        g = tuple(rng.integers(-2, 3, 3))
+        c = rng.standard_normal() * 0.05 + 1j * rng.standard_normal() * 0.05
+        vg[g] += c
+        vg[tuple(-np.array(g))] += np.conj(c)
+    veff_r = np.real(np.fft.ifftn(vg) * n)
+
+    lmmax_pot = 9  # lmax_pot = 2
+    v_mt_lm = rng.standard_normal((lmmax_pot, len(sp.r))) * 0.02
+    v_mt_lm[0] = 0.0  # spherical part lives in the radial basis
+    k = np.array([0.17, 0.05, 0.0])
+
+    th_box = np.fft.fftn(theta_r) / n
+    vth_box = np.fft.fftn(veff_r * theta_r) / n
+    Hd, Od = assemble_fv(
+        mill, k, lattice, pos, [rmt], [basis], [v_mt_lm],
+        th_box, vth_box, dims, omega,
+    )
+    p = build_fv_params(
+        mill, k, lattice, pos, [rmt], [basis], [v_mt_lm],
+        theta_r, veff_r, None, dims, omega,
+    )
+    return Hd, Od, p
+
+
+def test_apply_matches_dense():
+    Hd, Od, p = _setup()
+    ntot = Hd.shape[0]
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((3, ntot)) + 1j * rng.standard_normal((3, ntot))
+    hx, ox = apply_fv_h_o(p, jnp.asarray(x))
+    scale = np.abs(Hd).max()
+    np.testing.assert_allclose(
+        np.asarray(hx), x @ Hd.T, atol=2e-10 * scale * ntot**0.5
+    )
+    np.testing.assert_allclose(
+        np.asarray(ox), x @ Od.T, atol=2e-10 * np.abs(Od).max() * ntot**0.5
+    )
+
+
+def test_davidson_matches_dense_eigenvalues():
+    Hd, Od, p = _setup()
+    nev = 5
+    e_dense, _ = diagonalize_fv(Hd, Od, nev)
+    ev, x, rn = davidson_fv(p, nev, num_steps=40, res_tol=1e-10)
+    np.testing.assert_allclose(np.asarray(ev), e_dense, atol=5e-7)
+
+
+def test_iterative_scf_matches_dense_trajectory():
+    """run_scf_fp with iterative_solver.type=davidson follows the dense
+    path's per-iteration energies (test31 H-atom FP deck)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tests.conftest import requires_reference  # noqa: F401
+    import os
+
+    if not os.path.isdir("/root/reference/verification/test31"):
+        pytest.skip("reference data not available")
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.lapw.scf_fp import run_scf_fp
+
+    base = "/root/reference/verification/test31"
+    cfg = load_config(base + "/sirius.json")
+    cfg.parameters.num_dft_iter = 2
+    res_d = run_scf_fp(cfg, base_dir=base)
+    cfg2 = load_config(base + "/sirius.json")
+    cfg2.parameters.num_dft_iter = 2
+    cfg2.iterative_solver.type = "davidson"
+    cfg2.iterative_solver.num_steps = 40
+    res_i = run_scf_fp(cfg2, base_dir=base)
+    for a, b in zip(res_d["etot_history"], res_i["etot_history"]):
+        assert abs(a - b) < 1e-6, (a, b)
