@@ -1,0 +1,158 @@
+"""Small mini-app utilities: EOS task + unit-cell tools.
+
+Reference counterparts: the `eos` task of apps/mini_app/sirius.scf.cpp:412
+(scan volume scales, record E(V)) and apps/utils/unit_cell_tools.cpp
+(supercell construction from a 3x3 integer transformation)."""
+
+from __future__ import annotations
+
+import copy
+import json
+
+import numpy as np
+
+
+def birch_murnaghan_fit(volume, energy):
+    """3rd-order Birch-Murnaghan E(V) fit -> {v0, e0, b0 (Ha/bohr^3),
+    b0_GPa, bp}. Least squares on the standard form."""
+    v = np.asarray(volume, float)
+    e = np.asarray(energy, float)
+    if len(v) < 5:  # under-determined for the 4-parameter form
+        return None
+    # initial guesses from a parabola in v^{-2/3}
+    x = v ** (-2.0 / 3.0)
+    c = np.polyfit(x, e, 2)
+    v0 = (-c[1] / (2 * c[0])) ** (-3.0 / 2.0) if c[0] > 0 else v[np.argmin(e)]
+    p0 = [float(np.min(e)), float(v0), 0.01, 4.0]
+
+    def bm(vv, e0, v0_, b0, bp):
+        eta = (v0_ / vv) ** (2.0 / 3.0)
+        return e0 + 9.0 * v0_ * b0 / 16.0 * (
+            (eta - 1.0) ** 3 * bp + (eta - 1.0) ** 2 * (6.0 - 4.0 * eta)
+        )
+
+    try:
+        from scipy.optimize import curve_fit
+
+        popt, _ = curve_fit(bm, v, e, p0=p0, maxfev=20000)
+        e0, v0_, b0, bp = (float(t) for t in popt)
+    except Exception:  # no scipy / fit failure: E(V) data still useful
+        return None
+    return {
+        "e0": e0, "v0": v0_, "b0_Ha_bohr3": b0,
+        "b0_GPa": b0 * 29421.02648438959, "bp": bp,
+    }
+
+
+def run_eos(cfg_dict: dict, base_dir: str, volume_scale0: float,
+            volume_scale1: float, num_steps: int = 7,
+            output: str = "output_eos.json") -> dict:
+    """Reference eos task: for s in cbrt(linspace(scale0, scale1)), scale
+    the lattice, converge the ground state, record (volume, free energy).
+    Writes output_eos.json and returns the dict (with a Birch-Murnaghan
+    fit appended — the reference leaves fitting to the user)."""
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.dft.scf import run_scf
+
+    units = cfg_dict["unit_cell"].get("atom_coordinate_units", "lattice")
+    if units not in ("lattice", ""):
+        raise NotImplementedError(
+            "eos scales lattice vectors, which only preserves the structure "
+            f"with fractional atom coordinates (got '{units}')"
+        )
+    s0 = volume_scale0 ** (1.0 / 3.0)
+    s1 = volume_scale1 ** (1.0 / 3.0)
+    volume, energy, results = [], [], []
+    base_lat = np.asarray(cfg_dict["unit_cell"]["lattice_vectors"], float)
+    scale = float(cfg_dict["unit_cell"].get("lattice_vectors_scale", 1.0) or 1.0)
+    for i in range(num_steps):
+        s = s0 + i * (s1 - s0) / max(num_steps - 1, 1)
+        d = copy.deepcopy(cfg_dict)
+        d["unit_cell"]["lattice_vectors"] = (base_lat * s).tolist()
+        cfg = load_config(d)
+        res = run_scf(cfg, base_dir=base_dir)
+        omega = abs(np.linalg.det(base_lat * scale * s))
+        volume.append(omega)
+        energy.append(res["energy"]["free"])
+        results.append({
+            "scale": s, "converged": res["converged"],
+            "energy": res["energy"],
+        })
+    out = {"volume": volume, "energy": energy, "result": results}
+    fit = birch_murnaghan_fit(volume, energy)
+    if fit is not None:
+        out["birch_murnaghan"] = fit
+    with open(output, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
+def make_supercell(cfg_dict: dict, transform) -> dict:
+    """New input dict with lattice T @ a and atoms replicated into the
+    supercell (reference unit_cell_tools.cpp create_supercell). transform:
+    3x3 integer matrix (row-vectors convention, |det| = volume multiple)."""
+    T = np.asarray(transform, float).reshape(3, 3)
+    det = int(round(abs(np.linalg.det(T))))
+    if det < 1:
+        raise ValueError(f"singular supercell transform (det {det})")
+    uc = cfg_dict["unit_cell"]
+    units = uc.get("atom_coordinate_units", "lattice")
+    if units not in ("lattice", ""):
+        raise NotImplementedError(
+            f"supercell construction needs fractional atom coordinates "
+            f"(atom_coordinate_units='{units}' is Cartesian)"
+        )
+    a = np.asarray(uc["lattice_vectors"], float)
+    a_sc = T @ a
+    t_inv = np.linalg.inv(T)
+    # lattice translations of the primitive cell that fall inside the
+    # supercell: scan a bounding block of integer shifts
+    lim = int(np.ceil(np.abs(T).sum(axis=0).max())) + 1
+    shifts = []
+    rng = range(-lim, lim + 1)
+    for i in rng:
+        for j in rng:
+            for kk in rng:
+                f = np.array([i, j, kk], float) @ t_inv
+                if np.all(f > -1e-9) and np.all(f < 1.0 - 1e-9):
+                    shifts.append([i, j, kk])
+    if len(shifts) != det:
+        raise RuntimeError(
+            f"found {len(shifts)} interior translations, expected {det}"
+        )
+    out = copy.deepcopy(cfg_dict)
+    new_atoms = {}
+    for label, plist in uc["atoms"].items():
+        rows = []
+        for p in plist:
+            pos = np.asarray(p[:3], float)
+            extra = list(p[3:])
+            for sft in shifts:
+                f_sc = (pos + np.asarray(sft, float)) @ t_inv
+                f_sc = np.mod(f_sc, 1.0)
+                rows.append([float(x) for x in f_sc] + extra)
+        new_atoms[label] = rows
+    out["unit_cell"]["lattice_vectors"] = a_sc.tolist()
+    out["unit_cell"]["atoms"] = new_atoms
+    return out
+
+
+def unit_cell_tools_main(argv=None) -> int:
+    """CLI: sirius-unit-cell-tools --input sirius.json --supercell
+    "n1 n2 n3 n4 n5 n6 n7 n8 n9" [-o out.json]."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="sirius-unit-cell-tools")
+    p.add_argument("--input", default="sirius.json")
+    p.add_argument("--supercell", required=True,
+                   help="9 integers of the 3x3 transformation (row major)")
+    p.add_argument("-o", "--output", default="sirius_supercell.json")
+    args = p.parse_args(argv)
+    cfg = json.load(open(args.input))
+    T = [int(x) for x in args.supercell.split()]
+    out = make_supercell(cfg, T)
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    nat = sum(len(v) for v in out["unit_cell"]["atoms"].values())
+    print(f"supercell with {nat} atoms -> {args.output}")
+    return 0

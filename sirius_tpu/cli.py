@@ -20,9 +20,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--task",
         default="ground_state_new",
-        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "ground_state_direct", "k_point_path"],
+        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "ground_state_direct", "k_point_path", "eos"],
         help="calculation task (reference sirius.scf task semantics)",
     )
+    p.add_argument("--volume_scale0", type=float, default=0.95,
+                   help="eos task: first volume scale")
+    p.add_argument("--volume_scale1", type=float, default=1.05,
+                   help="eos task: last volume scale")
+    p.add_argument("--num_steps", type=int, default=7,
+                   help="eos task: number of volume points")
     p.add_argument(
         "--platform",
         default=None,
@@ -61,6 +67,23 @@ def main(argv: list[str] | None = None) -> int:
             print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
             return 2
         raise
+    if args.task == "eos":
+        from sirius_tpu.apps_util import run_eos
+
+        if args.test_against:
+            print(
+                "sirius-scf: --test_against is not supported by the eos "
+                "task (no reference eos artifacts in-tree)", file=sys.stderr,
+            )
+            return 2
+        cfg_dict = json.load(open(args.input))
+        out = run_eos(
+            cfg_dict, os.path.dirname(os.path.abspath(args.input)) or ".",
+            args.volume_scale0, args.volume_scale1, num_steps=args.num_steps,
+        )
+        for v, e in zip(out["volume"], out["energy"]):
+            print(f"volume: {v}, energy: {e}")
+        return 0
     return run_scf_from_file(args.input, test_against=args.test_against, task=args.task)
 
 

@@ -1,0 +1,57 @@
+"""EOS helpers + unit-cell tools (reference apps/mini_app eos task,
+apps/utils/unit_cell_tools.cpp)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sirius_tpu.apps_util import birch_murnaghan_fit, make_supercell
+
+
+def test_birch_murnaghan_roundtrip():
+    """Fit recovers the parameters of a synthetic BM curve."""
+    e0, v0, b0, bp = -10.0, 120.0, 0.004, 4.3
+    v = np.linspace(100.0, 145.0, 9)
+    eta = (v0 / v) ** (2.0 / 3.0)
+    e = e0 + 9.0 * v0 * b0 / 16.0 * (
+        (eta - 1.0) ** 3 * bp + (eta - 1.0) ** 2 * (6.0 - 4.0 * eta)
+    )
+    fit = birch_murnaghan_fit(v, e)
+    assert fit is not None
+    assert abs(fit["e0"] - e0) < 1e-8
+    assert abs(fit["v0"] - v0) < 1e-5
+    assert abs(fit["b0_Ha_bohr3"] - b0) < 1e-7
+    assert abs(fit["bp"] - bp) < 1e-4
+
+
+@pytest.mark.parametrize("T,mult", [
+    (np.diag([2, 1, 1]), 2),
+    (np.diag([2, 2, 2]), 8),
+    ([[1, 1, 0], [1, -1, 0], [0, 0, 1]], 2),  # non-diagonal
+])
+def test_make_supercell(T, mult):
+    cfg = {
+        "unit_cell": {
+            "lattice_vectors": (np.eye(3) * 5.0).tolist(),
+            "atoms": {"Si": [[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]]},
+            "atom_files": {"Si": "Si.json"},
+        }
+    }
+    out = make_supercell(cfg, T)
+    a0 = np.asarray(cfg["unit_cell"]["lattice_vectors"])
+    a1 = np.asarray(out["unit_cell"]["lattice_vectors"])
+    # volume multiplies by |det T|
+    assert abs(abs(np.linalg.det(a1)) / abs(np.linalg.det(a0)) - mult) < 1e-9
+    atoms = out["unit_cell"]["atoms"]["Si"]
+    assert len(atoms) == 2 * mult
+    # every replicated atom maps back onto a primitive lattice site
+    Ti = np.asarray(T, float)
+    for f_sc in atoms:
+        r_cart = np.asarray(f_sc) @ a1
+        f_prim = r_cart @ np.linalg.inv(a0)
+        d = np.abs(f_prim - np.round(f_prim * 4) / 4)  # on the 1/4 grid
+        assert d.max() < 1e-9, (f_sc, f_prim)
+    # original config untouched
+    assert len(cfg["unit_cell"]["atoms"]["Si"]) == 2
