@@ -150,6 +150,8 @@ def unit_cell_tools_main(argv=None) -> int:
     args = p.parse_args(argv)
     cfg = json.load(open(args.input))
     T = [int(x) for x in args.supercell.split()]
+    if len(T) != 9:
+        p.error(f"--supercell needs 9 integers (3x3, row major); got {len(T)}")
     out = make_supercell(cfg, T)
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)

@@ -1,0 +1,153 @@
+"""Second-variational Hamiltonian with spin-orbit coupling and full
+non-collinear B fields (FP-LAPW).
+
+Re-design of the reference's apply_so_correction (hamiltonian.cpp:209),
+Atom_symmetry_class::generate_so_radial_integrals
+(atom_symmetry_class.cpp:697-735) and the non-collinear second-variational
+branch of diagonalize_fp.hpp:343-507. The first-variational states span a
+spin-degenerate basis; the second variation solves the 2 nev x 2 nev
+problem
+
+  H_sv = diag(e_fv) (x) 1 + [[ B_z + xi Lz , B_- + xi L_- ],
+                             [ B_+ + xi L_+, -B_z - xi Lz ]]
+
+with B_+- = B_x +- i B_y matrix elements over fv states and the SO
+coupling xi projected through the MT expansion coefficients. The 1/2 of
+the physical xi_phys L.S sits INSIDE xi (the radial integral carries
+alpha^2/4 instead of alpha^2/2) — the reference's convention. Angular
+matrices live in THIS package's real-harmonic convention
+(ops/so._l_matrices_real), so phase conventions match the rest of the MT
+machinery by construction.
+
+The collinear path in lapw/scf_fp.py keeps its cheaper sigma_z solve; the
+full non-collinear FP SCF (vector MT magnetization) is the remaining gap
+and is documented as such in COVERAGE.md.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sirius_tpu.lapw.quad import rint
+
+ALPHA2_4 = 0.25 / 137.035999084**2  # (alpha/2)^2 = 1/(2c)^2
+
+
+def so_weight(r: np.ndarray, v_sph: np.ndarray, zn: float) -> np.ndarray:
+    """Radial SO weight w(r) = (alpha^2/4) [ dVe/dr * r + Z/r ] / M^2 with
+    Ve the ELECTRONIC spherical potential (nucleus removed) and
+    M = 1 - (alpha^2/2) V_sph (reference atom_symmetry_class.cpp:697-731);
+    pair-independent, so hoisted out of the (u1, u2) double loop."""
+    from sirius_tpu.core.radial import Spline
+
+    ve = v_sph + zn / r  # electronic part
+    dve = np.asarray(Spline(r, ve).derivative(r))
+    m = 1.0 - 2.0 * ALPHA2_4 * v_sph
+    return ALPHA2_4 * (dve * r + zn / r) / m**2
+
+
+def so_radial_integral(r: np.ndarray, v_sph: np.ndarray, zn: float,
+                       u1: np.ndarray, u2: np.ndarray) -> float:
+    """xi(o1, o2) = int u1 u2 w(r) dr. Against the physical
+    xi(r) = (alpha^2/2) (1/M^2) (1/r) dV/dr this carries a factor 1/2,
+    absorbed by using L.S WITHOUT the 1/2 in the Hamiltonian blocks —
+    mirrored from the reference convention."""
+    return float(rint(u1 * u2 * so_weight(r, v_sph, zn), r))
+
+
+def so_blocks_for_atom(basis, v_sph: np.ndarray, zn: float):
+    """Per-atom SO coupling in the flat MT expansion index of
+    density_fp.mt_index: four [nidx, nidx] complex blocks (uu, dd, ud, du)
+    of xi * (Lz, -Lz, L-, L+) — reference apply_so_correction uses exactly
+    these weights (m*xi on the diagonal spin blocks, the full ladder
+    coefficient off-diagonal)."""
+    from sirius_tpu.lapw.density_fp import mt_index
+    from sirius_tpu.ops.so import _l_matrices_real
+
+    r = basis.r
+    rf, lm_of, rf_of = mt_index(basis, basis.lmax_apw)
+    # l of each radial function, in the SAME aw-then-lo order mt_index
+    # builds (its MtRadial entries carry their l)
+    rf_l = [f.l for l in range(basis.lmax_apw + 1) for f in basis.aw[l]]
+    rf_l += [f.l for f in basis.lo]
+    nrf = len(rf)
+    # xi over radial-function pairs of equal l; the pair-independent
+    # weight is computed once
+    w = so_weight(r, v_sph, zn)
+    xi = np.zeros((nrf, nrf))
+    for i in range(nrf):
+        for j in range(nrf):
+            if rf_l[i] == rf_l[j] and rf_l[i] > 0:
+                xi[i, j] = float(rint(rf[i] * rf[j] * w, r))
+    # angular matrices per l in the real-harmonic basis
+    lmats = {}
+    for l in range(max(rf_l) + 1):
+        if l == 0:
+            continue
+        L, _C = _l_matrices_real(l)
+        lmats[l] = tuple(L)
+    nidx = len(lm_of)
+    uu = np.zeros((nidx, nidx), dtype=np.complex128)
+    dd = np.zeros_like(uu)
+    ud = np.zeros_like(uu)
+    du = np.zeros_like(uu)
+    # lm -> (l, m-index) decode
+    l_of_lm = []
+    for l in range(64):
+        l_of_lm += [l] * (2 * l + 1)
+        if len(l_of_lm) > max(lm_of, default=0):
+            break
+    l_of_lm = np.asarray(l_of_lm)
+    for p in range(nidx):
+        lp = int(l_of_lm[lm_of[p]])
+        if lp == 0:
+            continue
+        mp = lm_of[p] - lp * lp  # 0 .. 2l
+        for q in range(nidx):
+            lq = int(l_of_lm[lm_of[q]])
+            if lq != lp:
+                continue
+            x = xi[rf_of[p], rf_of[q]]
+            if x == 0.0:
+                continue
+            mq = lm_of[q] - lq * lq
+            lx, ly, lz = lmats[lp]
+            lm_ = lx[mp, mq] - 1j * ly[mp, mq]
+            lp_ = lx[mp, mq] + 1j * ly[mp, mq]
+            uu[p, q] += x * lz[mp, mq]
+            dd[p, q] -= x * lz[mp, mq]
+            ud[p, q] += x * lm_
+            du[p, q] += x * lp_
+    return uu, dd, ud, du
+
+
+def sv_hamiltonian(e_fv: np.ndarray, bz_ij=None, bx_ij=None, by_ij=None,
+                   so_proj=None) -> np.ndarray:
+    """Assemble the 2 nev x 2 nev second-variational Hamiltonian.
+
+    e_fv [nev]: first-variational energies; b*_ij [nev, nev]: B-field
+    matrix elements over fv states (None = zero); so_proj: (uu, dd, ud,
+    du) [nev, nev] blocks of the projected SO operator (None = no SO)."""
+    nev = len(e_fv)
+    z = np.zeros((nev, nev), dtype=np.complex128)
+    bz = z if bz_ij is None else np.asarray(bz_ij, dtype=np.complex128)
+    bx = z if bx_ij is None else np.asarray(bx_ij, dtype=np.complex128)
+    by = z if by_ij is None else np.asarray(by_ij, dtype=np.complex128)
+    so_uu = so_dd = so_ud = so_du = z
+    if so_proj is not None:
+        so_uu, so_dd, so_ud, so_du = (
+            np.asarray(t, dtype=np.complex128) for t in so_proj
+        )
+    e = np.diag(np.asarray(e_fv, float))
+    h = np.zeros((2 * nev, 2 * nev), dtype=np.complex128)
+    h[:nev, :nev] = e + bz + so_uu
+    h[nev:, nev:] = e - bz + so_dd
+    h[:nev, nev:] = (bx - 1j * by) + so_ud
+    h[nev:, :nev] = (bx + 1j * by) + so_du
+    return 0.5 * (h + h.conj().T)
+
+
+def project_so(so_blocks, W: np.ndarray):
+    """Project per-atom MT SO blocks through the MT expansion matrix
+    W [nidx, nev] -> four [nev, nev] fv-basis blocks."""
+    return tuple(W.conj().T @ b @ W for b in so_blocks)
