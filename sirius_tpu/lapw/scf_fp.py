@@ -408,7 +408,17 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
     num_done = 0
     core_esum_tot = 0.0
 
+    _tm: dict = {}
+    _t_mark = [time.perf_counter()]
+
+    def _lap(name):
+        now = time.perf_counter()
+        cnt, tot = _tm.get(name, (0, 0.0))
+        _tm[name] = (cnt + 1, tot + (now - _t_mark[0]))
+        _t_mark[0] = now
+
     for it in range(p.num_dft_iter):
+        _t_mark[0] = time.perf_counter()
         # ---- potential from current density ----
         # Hartree: Weinert pseudocharge
         qmt = []
@@ -474,6 +484,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         veff_r = vh_r + vxc_r
         veff_mt = [vh_mt[ia] + vxc_mt[ia] for ia in range(nat)]
 
+        _lap("fp::potential")
         # ---- radial basis at the current spherical potential ----
         basis_by_atom = []
         core_rho, core_esum, core_leak = [], 0.0, 0.0
@@ -495,6 +506,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         e_floor_fv = min(enu_all) - 5.0
         core_esum_tot = core_esum
 
+        _lap("fp::radial_core")
         # ---- band problem per k: first variation (no B field) ----
         # iterative (matrix-free) fv solve when the deck asks for davidson
         # (reference diagonalize_fp.hpp:271); dense exact is the default
@@ -603,6 +615,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
                 )
             W_k.append(Ws)
 
+        _lap("fp::fv_solve")
         # ---- second variation: diagonal fv energies + sigma_z B coupling
         # (reference diagonalize_fp.hpp second-variational branch) ----
         if nm:
@@ -665,6 +678,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         )
         occ_np = np.asarray(occ)  # [nk, ns, nev]
 
+        _lap("fp::sv_occupancy")
         # ---- new density (per spin channel) ----
         rho_mt_new, mag_mt_new = [], []
         for ia in range(nat):
@@ -756,6 +770,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         istl_charge = ctx.istl_integral(rho_r_new, np.ones(ctx.dims))
         total_charge = mt_charge + istl_charge
 
+        _lap("fp::density")
         # ---- energies (at the INPUT potential, OUTPUT density) ----
         eval_sum = float(
             np.sum(
@@ -807,6 +822,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         }
         etot_history.append(e_total)
 
+        _lap("fp::energies")
         # ---- mix ----
         x_in = pack(rho_ig, rho_mt, mag_ig, mag_mt)
         x_out = pack(
@@ -845,6 +861,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
             break
         x_mix = mixer.mix(x_in, x_out)
         rho_ig, rho_mt, mag_ig, mag_mt = unpack(x_mix)
+        _lap("fp::mix")
 
     band_gap = 0.0
     ev_flat = np.asarray(evals)
@@ -889,7 +906,10 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         "band_energies": np.asarray(evals).tolist(),
         "band_occupancies": occ_np.tolist(),
         "counters": {},
-        "timers": {},
+        "timers": {
+            k: {"count": c, "total": round(v, 2)}
+            for k, (c, v) in sorted(_tm.items(), key=lambda kv: -kv[1][1])
+        },
         **({"magnetisation": mag_result} if mag_result else {}),
     }
 

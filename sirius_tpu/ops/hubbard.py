@@ -654,9 +654,20 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
 
     state: {"err": float, "steps": int} carried by the SCF loop. Returns
     (lagrange, active_for_next_potential)."""
+    import os
+
     c = hub.constraint
     if c is None or om_cons is None:
         return lagrange, False
+    if os.environ.get("SIRIUS_TPU_DEBUG_CONS"):
+        dd = om - om_cons
+        for e in c["local"]:
+            b = hub.find_block(int(e["atom_index"]), int(e.get("n", 0)), int(e["l"]))
+            sl = slice(b.off, b.off + b.nm)
+            print(f"[cons] steps={state['steps']} err_prev={state['err']:.4f} "
+                  f"max|om-target| per spin="
+                  f"{[float(np.abs(dd[s, sl, sl]).max()) for s in range(dd.shape[0])]}",
+                  flush=True)
     active = (
         state["err"] > c["error"] and state["steps"] < c["max_iteration"]
     )

@@ -103,6 +103,13 @@ def run_deck(name: str) -> dict:
     if "magnetisation" in res and "magnetisation" in ref:
         rec["mag_total"] = res["magnetisation"]["total"]
         rec["mag_total_ref"] = ref["magnetisation"]["total"]
+    # condensed wall-time breakdown (top timers; reference prints the same
+    # rt_graph tree at finalize) — makes every deck run a profile artifact
+    timers = res.get("timers") or {}
+    rec["timers_top"] = {
+        k: round(v["total"], 1)
+        for k, v in list(timers.items())[:6]
+    }
     return rec
 
 
