@@ -741,10 +741,10 @@ def run_scf(
                 ctx, hub, psi, occ_np, ctx.max_occupancy
             )
             # Constrained-occupancy runs keep the RAW k-weighted om: the
-            # recorded reference outputs (test30) require the om to reach a
-            # target that is NOT invariant under the crystal group (its eg
-            # off-diagonal -0.351 cannot survive any 48-op average), so the
-            # run that produced them cannot have symmetrized the om.
+            # stable dual-ascent drives the om to a target that is NOT
+            # invariant under the crystal group (test30's eg off-diagonal
+            # -0.351 cannot survive the symmetry average), so the om is
+            # left unsymmetrized while a constraint is configured.
             if do_symmetrize and hub_om_cons is None:
                 om_new, om_nl_new = symmetrize_occupation(
                     ctx, hub, om_new, occ_T
@@ -855,10 +855,25 @@ def run_scf(
         if not np.all(np.isfinite(evals)) or not np.isfinite(
             np.sum(np.abs(x_new))
         ):
+            bad = [
+                name
+                for name, a in [
+                    ("evals", evals),
+                    ("rho_new", rho_new),
+                    ("mag_new", mag_new if polarized else np.zeros(1)),
+                    ("om_new", om_new if hub is not None else np.zeros(1)),
+                    ("om_nl_new", np.concatenate([np.ravel(o) for o in om_nl_new]) if (hub is not None and om_nl_new) else np.zeros(1)),
+                    ("paw_dm_new", paw_dm_new if paw_dm_new is not None else np.zeros(1)),
+                    ("lagrange", hub_lagrange if hub_lagrange is not None else np.zeros(1)),
+                    ("veff_in", pot.veff_r_coarse),
+                    ("vhub_in", vhub if vhub is not None else np.zeros(1)),
+                    ("rho_in", rho_g),
+                ]
+                if not np.all(np.isfinite(np.asarray(a)))
+            ]
             raise FloatingPointError(
-                f"SCF diverged at iteration {it + 1}: non-finite band "
-                "energies or density (try smaller mixer.beta or a better "
-                "initial guess)"
+                f"SCF diverged at iteration {it + 1}: non-finite {bad} "
+                "(try smaller mixer.beta or a better initial guess)"
             )
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
@@ -906,6 +921,20 @@ def run_scf(
         # --- potential + energies ---
         with profile("scf::potential"):
             pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
+        if not np.all(np.isfinite(np.asarray(pot.veff_r_coarse))):
+            import os as _os
+
+            if _os.environ.get("SIRIUS_TPU_DUMP_DIVERGED"):
+                np.savez(
+                    _os.environ["SIRIUS_TPU_DUMP_DIVERGED"],
+                    rho_g=rho_g,
+                    mag_g=mag_g if mag_g is not None else np.zeros(1),
+                )
+            raise FloatingPointError(
+                f"potential non-finite at iteration {it + 1} from finite "
+                f"density (rho finite={np.all(np.isfinite(rho_g))}, "
+                f"mag finite={mag_g is None or np.all(np.isfinite(mag_g))})"
+            )
         if _cks.enabled():
             _cks.checksum("veff", pot.veff_g)
         scf_correction = (

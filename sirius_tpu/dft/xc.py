@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 
 _TINY = 1e-25
+# vacuum threshold for a spin channel (libxc dens_threshold analog)
+_DENS_TH = 1e-13
 
 
 def _lda_x_e(nu: jnp.ndarray, nd: jnp.ndarray) -> jnp.ndarray:
@@ -332,15 +334,38 @@ class XCFunctional:
         return e
 
     def _eval(self, nu, nd, suu, sud, sdd, tu, td):
+        # libxc-style density threshold: a spin channel below _DENS_TH is
+        # vacuum. The clip in the caller can produce EXACTLY zero channels
+        # (fully polarized points, m = -rho); autodiff of the GGA chain at
+        # n = 0 with finite sigma yields inf * 0 = NaN in v/vsigma even
+        # though the energy itself is finite (observed: test30 NiO FM mid-
+        # SCF). Inputs are sanitized BEFORE the grad (the double-where
+        # pattern) and dead-channel outputs masked to zero, which is what
+        # libxc's dens_threshold does.
+        th = _DENS_TH
+        up0 = nu < th
+        dn0 = nd < th
+        nu_s = jnp.where(up0, th, nu)
+        nd_s = jnp.where(dn0, th, nd)
+        suu_s = jnp.where(up0, 0.0, suu)
+        sud_s = jnp.where(up0 | dn0, 0.0, sud)
+        sdd_s = jnp.where(dn0, 0.0, sdd)
         grads = jax.grad(
             lambda a, b, c, d, f, g, h: jnp.sum(
                 self._energy(a, b, c, d, f, g, h)
             ),
             argnums=(0, 1, 2, 3, 4, 5, 6),
         )
-        vu, vd, vsuu, vsud, vsdd, vtu, vtd = grads(nu, nd, suu, sud, sdd, tu, td)
+        vu, vd, vsuu, vsud, vsdd, vtu, vtd = grads(
+            nu_s, nd_s, suu_s, sud_s, sdd_s, tu, td
+        )
+        vu = jnp.where(up0, 0.0, vu)
+        vd = jnp.where(dn0, 0.0, vd)
+        vsuu = jnp.where(up0, 0.0, vsuu)
+        vsud = jnp.where(up0 | dn0, 0.0, vsud)
+        vsdd = jnp.where(dn0, 0.0, vsdd)
         return (
-            self._energy(nu, nd, suu, sud, sdd, tu, td),
+            self._energy(nu_s, nd_s, suu_s, sud_s, sdd_s, tu, td),
             vu, vd, vsuu, vsud, vsdd, vtu, vtd,
         )
 

@@ -275,17 +275,11 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
     alpha = -(R * R * dsv + sp.zn)
     beta = svmt[-1] - (sp.zn + alpha) / R
     v = np.concatenate([v_sph, alpha / r_ext + beta])
-    # deep-core eigenvalues need better than the basis grid's RK4 step:
-    # solve on the midpoint-refined grid (error / 16; reference uses an
-    # adaptive RK8 integrator, radial_solver.hpp gsl rk8pd)
-    from sirius_tpu.lapw.radial_solver import _with_midpoints
-
-    r_fine = np.empty(2 * len(r) - 1)
-    r_fine[0::2] = r
-    r_fine[1::2] = 0.5 * (r[:-1] + r[1:])
-    v_fine = _with_midpoints(r, v)
-    nmt_fine = 2 * len(r_mt) - 1
-    r, v = r_fine, v_fine
+    # deep-core eigenvalues need better than the basis grid's RK4 step;
+    # the bound-state solvers refine internally (radial_solver._refine_grid,
+    # refine=1 default — the reference reaches the same accuracy class with
+    # its adaptive GSL integrator, radial_solver.hpp:344)
+    nmt = len(r_mt)
     rho = np.zeros_like(r)
     esum = 0.0
     for (nql, l, occ) in sp.core_states():
@@ -304,12 +298,9 @@ def core_states_density(sp, v_sph, rel: str = "dirac"):
             e, u = find_bound_state(r, v, l, nql, rel=rel, e_lo=e_floor)
             esum += occ * e
             rho += occ * u**2 / (4.0 * np.pi)
-    # rho lives on the midpoint-REFINED grid: sample back on the original
-    # MT points (even indices) — slicing by the coarse point count would
-    # return fine-grid values at wrong radii (Fe: a 354179-electron "core")
-    rho_mt_out = rho[0:nmt_fine:2]
+    rho_mt_out = rho[:nmt]
     leak = 4.0 * np.pi * np.trapezoid(
-        rho[nmt_fine - 1 :] * r[nmt_fine - 1 :] ** 2, r[nmt_fine - 1 :]
+        rho[nmt - 1 :] * r[nmt - 1 :] ** 2, r[nmt - 1 :]
     )
     return rho_mt_out, esum, leak
 
@@ -408,13 +399,14 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
     num_done = 0
     core_esum_tot = 0.0
 
-    _tm: dict = {}
+    from sirius_tpu.utils.profiler import add_time, reset_timers, timer_report
+
+    reset_timers()
     _t_mark = [time.perf_counter()]
 
     def _lap(name):
         now = time.perf_counter()
-        cnt, tot = _tm.get(name, (0, 0.0))
-        _tm[name] = (cnt + 1, tot + (now - _t_mark[0]))
+        add_time(name, now - _t_mark[0])
         _t_mark[0] = now
 
     for it in range(p.num_dft_iter):
@@ -906,10 +898,7 @@ def run_scf_fp(cfg, base_dir: str = ".") -> dict:
         "band_energies": np.asarray(evals).tolist(),
         "band_occupancies": occ_np.tolist(),
         "counters": {},
-        "timers": {
-            k: {"count": c, "total": round(v, 2)}
-            for k, (c, v) in sorted(_tm.items(), key=lambda kv: -kv[1][1])
-        },
+        "timers": timer_report(),
         **({"magnetisation": mag_result} if mag_result else {}),
     }
 

@@ -686,6 +686,19 @@ def constraint_update(hub: HubbardData, om: np.ndarray, lagrange, om_cons,
         sl = slice(b.off, b.off + b.nm)
         mask[:, sl, sl] = True
         err = max(err, float(np.abs(diff[:, sl, sl]).max()))
+    # Stable dual-ascent sign (see the docstring above). The literal
+    # reference sign (lambda += beta*diff with V -= s*lambda,
+    # occupation_matrix.cpp:340 + hubbard_potential_energy.cpp:33) was
+    # re-tried this round after the lm_order and Anderson fixes: it now
+    # survives the swing phase (the former NaN was the dead-spin-channel
+    # autodiff hole fixed in dft/xc._eval) and reaches the reference's
+    # mag +2 basin, but lambda grows without bound (err stays ~0.97, the
+    # release rule never fires) and the total drifts ~+0.5 Ha/iteration.
+    # The reference's own lambda trajectory is shaped by a quirk of its
+    # mixer (mixer_functions.cpp copy_func iterates nonlocal().size() —
+    # zero here — so history slots never see lambda) that we do not
+    # reproduce; its recorded test30 state is that lambda-dressed fixed
+    # point.
     lagrange = lagrange - c["beta_mixing"] * np.where(mask, diff, 0.0)
     state["err"] = err
     state["steps"] += 1

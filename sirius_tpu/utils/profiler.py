@@ -32,6 +32,11 @@ def profile(name: str):
         _STACK.pop()
 
 
+def add_time(name: str, dt: float) -> None:
+    """Record an externally-measured span (same registry as profile())."""
+    _TIMINGS[name].append(dt)
+
+
 def reset_timers() -> None:
     _TIMINGS.clear()
     counters.clear()
