@@ -506,6 +506,11 @@ def run_scf(
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
     num_iter_done = 0
     itsol = cfg.iterative_solver
+    # adaptive band-solve tolerance, tightened each iteration with the
+    # density residual (reference schedule dft_ground_state.cpp:252-259);
+    # a static bar leaves a locked-band noise floor in the density that can
+    # sit just above density_tol and stall tight decks at num_dft_iter
+    res_tol = itsol.residual_tolerance
 
     for it in range(p.num_dft_iter):
         # --- band solve per (k, spin) (warm start) ---
@@ -578,7 +583,7 @@ def run_scf(
                     jnp.asarray(hd, dtype=rdt), jnp.asarray(od, dtype=rdt),
                     gsh["mask"],
                     num_steps=itsol.num_steps,
-                    res_tol=itsol.residual_tolerance,
+                    res_tol=res_tol,
                 )
                 gsh["psi"] = x
                 evals[0, 0] = np.asarray(ev)
@@ -636,7 +641,7 @@ def run_scf(
                             jnp.asarray(o_diag, dtype=rdt),
                             params.mask,
                             num_steps=itsol.num_steps,
-                            res_tol=itsol.residual_tolerance,
+                            res_tol=res_tol,
                         )
                         evals[ik, ispn] = np.asarray(ev)
                         per_spin.append(x)
@@ -699,13 +704,13 @@ def run_scf(
                         ps, jnp.asarray(pot.vtau_r_coarse, dtype=rdt),
                         _gkc_dev(rdt), pr, pi,
                         num_steps=itsol.num_steps,
-                        res_tol=itsol.residual_tolerance,
+                        res_tol=res_tol,
                     )
                 else:
                     ev, pr, pi, rn = davidson_kset(
                         ps, pr, pi,
                         num_steps=itsol.num_steps,
-                        res_tol=itsol.residual_tolerance,
+                        res_tol=res_tol,
                     )
                 # psi stays device-resident as the (pr, pi) pair between
                 # iterations; the complex host copy is materialized only for
@@ -885,6 +890,20 @@ def run_scf(
         eha_res = mixer.residual_hartree_energy(x_mix, x_new)
         dens_metric = (
             eha_res if (mixer.use_hartree and eha_res is not None) else rms
+        )
+        # tighten next iteration's band-solve bar with the density residual
+        # (reference dft_ground_state.cpp:252-259: tol = min(scale0 * metric,
+        # scale1 * tol_prev) clamped at min_tolerance; with use_hartree the
+        # metric is eha_res per electron)
+        _m = (
+            dens_metric / max(1.0, nel)
+            if (mixer.use_hartree and eha_res is not None)
+            else rms
+        )
+        res_tol = max(
+            itsol.min_tolerance,
+            min(itsol.tolerance_scale[0] * _m,
+                itsol.tolerance_scale[1] * res_tol),
         )
         rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, lam_mixed = unpack(x_mix)
         if lam_mixed is not None:

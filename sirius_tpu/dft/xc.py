@@ -364,6 +364,10 @@ class XCFunctional:
         vsuu = jnp.where(up0, 0.0, vsuu)
         vsud = jnp.where(up0 | dn0, 0.0, vsud)
         vsdd = jnp.where(dn0, 0.0, vsdd)
+        # de/dtau diverges as n^{-2/3} at the sanitized point n = th — a
+        # dead channel must get vtau = 0 too (libxc dens_threshold)
+        vtu = jnp.where(up0, 0.0, vtu)
+        vtd = jnp.where(dn0, 0.0, vtd)
         return (
             self._energy(nu_s, nd_s, suu_s, sud_s, sdd_s, tu, td),
             vu, vd, vsuu, vsud, vsdd, vtu, vtd,
