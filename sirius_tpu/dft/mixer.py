@@ -231,3 +231,18 @@ class Mixer:
             self._x.pop(0)
             self._f.pop(0)
         return nxt
+
+
+def schedule_res_tol(itsol, res_tol: float, dens_metric: float, nel: float,
+                     hartree_metric: bool) -> float:
+    """Next iteration's band-solve residual bar from the density residual
+    (reference dft_ground_state.cpp:252-259): tol = min(scale0 * metric,
+    scale1 * tol_prev), clamped at min_tolerance. With the Hartree metric
+    the density bar is an energy — scale it per electron as the reference
+    does before feeding the solver."""
+    m = dens_metric / max(1.0, nel) if hartree_metric else dens_metric
+    return max(
+        itsol.min_tolerance,
+        min(itsol.tolerance_scale[0] * m,
+            itsol.tolerance_scale[1] * res_tol),
+    )

@@ -25,7 +25,7 @@ from sirius_tpu.dft.density import (
     rho_real_space,
     symmetrize_pw,
 )
-from sirius_tpu.dft.mixer import Mixer
+from sirius_tpu.dft.mixer import Mixer, schedule_res_tol
 from sirius_tpu.dft.occupation import find_fermi
 from sirius_tpu.dft.potential import generate_potential
 from sirius_tpu.dft.xc import XCFunctional
@@ -501,6 +501,31 @@ def run_scf(
     if gsh_want:
         gsh = _setup_gshard(wf_dtype)
         scf_mesh = None  # the "g" mesh replaces the (k, b) mesh
+    # Gamma-point real-storage band solve (ops/gamma.py; reference
+    # reduce_gvec, wave_functions.hpp:1589-1626): packed-real vectors make
+    # the solver's GEMMs/eigh real. Hubbard needs the complex per-k U
+    # apply and mGGA the complex tau operator — both keep the generic path.
+    gamma_bands = (
+        cfg.control.reduce_gvec
+        and not serial_bands
+        and gsh is None
+        and nk == 1
+        and float(np.abs(np.asarray(ctx.gkvec.kpoints[0])).max()) < 1e-12
+        and hub is None
+        and not mgga
+        # multi-device runs keep the band-sharded batched path — the packed
+        # solve is single-device and would idle the rest of the mesh
+        and jax.device_count() == 1
+    )
+    gm = None
+    x_packed: list = [None] * ns
+    gamma_cache: dict = {}  # rdtype -> constant-table GammaParams
+    if gamma_bands:
+        from sirius_tpu.ops.gamma import build_gamma_map
+
+        gm = build_gamma_map(
+            np.asarray(ctx.gkvec.millers[0]), np.asarray(ctx.gkvec.mask[0])
+        )
     mu, occ, entropy_sum = 0.0, jnp.zeros((nk, ns, nb)), 0.0
     etot_history, rms_history, mag_history = [], [], []
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
@@ -595,6 +620,72 @@ def run_scf(
                         np.asarray(x), gsh["order"], ctx.gkvec.ngk_max
                     )
                 )[None, None]
+            elif gamma_bands:
+                from sirius_tpu.ops.gamma import (
+                    davidson_gamma,
+                    make_gamma_params,
+                    pack_diags,
+                )
+                from sirius_tpu.ops.gamma import pack as gpack
+                from sirius_tpu.ops.gamma import unpack as gunpack
+                from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+                rdt = real_dtype_of(wf_dtype)
+                if x_packed[0] is not None and x_packed[0].dtype != np.dtype(rdt):
+                    # fp32 -> fp64 polish: re-cast the packed block
+                    x_packed = [jnp.asarray(x, dtype=rdt) for x in x_packed]
+                if psi is not None and x_packed[0] is None:
+                    # restart / warm start from full complex psi
+                    x_packed = [
+                        jnp.asarray(gpack(gm, np.asarray(psi[0, ispn])), dtype=rdt)
+                        for ispn in range(ns)
+                    ]
+                psi_out = np.zeros(
+                    (1, ns, nb, ctx.gkvec.ngk_max), dtype=np.complex128
+                )
+                if rdt not in gamma_cache:
+                    # constant tables (packed beta, gather maps) uploaded
+                    # once per precision; per-iteration leaves swapped below
+                    gamma_cache[rdt] = make_gamma_params(
+                        ctx, np.zeros(ctx.fft_coarse.dims), gm, rdtype=rdt
+                    )
+                for ispn in range(ns):
+                    gp = gamma_cache[rdt]._replace(
+                        veff_r=jnp.asarray(pot.veff_r_coarse[ispn], dtype=rdt),
+                        dion=jnp.asarray(np.real(d_by_spin[ispn]), dtype=rdt),
+                    )
+                    if x_packed[ispn] is None:
+                        # first iteration: rotate the packed LCAO block to
+                        # the lowest nb Ritz vectors (initialize_subspace)
+                        from sirius_tpu.solvers.davidson import (
+                            subspace_rotate,
+                        )
+                        from sirius_tpu.ops.gamma import apply_h_s_gamma
+
+                        xb = jnp.asarray(
+                            gpack(gm, psi_big[0, ispn]), dtype=rdt
+                        )
+                        hx, sx = apply_h_s_gamma(gp, xb)
+                        x_packed[ispn] = subspace_rotate(
+                            xb, hx, sx, nb, mask=gp.mask_p
+                        ).astype(rdt)
+                        counters["num_loc_op_applied"] += psi_big.shape[2]
+                    h_diag, o_diag = _h_o_diag(ctx, 0, v0, d_by_spin[ispn])
+                    hd_p, od_p = pack_diags(
+                        gm, np.asarray(h_diag), np.asarray(o_diag)
+                    )
+                    ev, xg, rn = davidson_gamma(
+                        gp, x_packed[ispn],
+                        jnp.asarray(hd_p, dtype=rdt),
+                        jnp.asarray(od_p, dtype=rdt),
+                        num_steps=itsol.num_steps,
+                        res_tol=res_tol,
+                    )
+                    evals[0, ispn] = np.asarray(ev)
+                    x_packed[ispn] = xg
+                    psi_out[0, ispn] = gunpack(gm, np.asarray(xg))
+                psi = psi_out
+                psi_big = None
             elif serial_bands:
                 if psi is None and psi_big is not None:
                     # first iteration from a fresh LCAO block: rotate the
@@ -781,7 +872,7 @@ def run_scf(
         # --- density (per spin, then charge/magnetization assembly) ---
         occ_w = jnp.asarray(occ_np * ctx.kweights[:, None, None])
         with profile("scf::density"):
-            if serial_bands or gsh is not None:
+            if serial_bands or gamma_bands or gsh is not None:
                 rho_spin = generate_density_g(ctx, psi, occ_np)
             else:
                 from sirius_tpu.dft.density import density_from_coarse_acc
@@ -891,20 +982,8 @@ def run_scf(
         dens_metric = (
             eha_res if (mixer.use_hartree and eha_res is not None) else rms
         )
-        # tighten next iteration's band-solve bar with the density residual
-        # (reference dft_ground_state.cpp:252-259: tol = min(scale0 * metric,
-        # scale1 * tol_prev) clamped at min_tolerance; with use_hartree the
-        # metric is eha_res per electron)
-        _m = (
-            dens_metric / max(1.0, nel)
-            if (mixer.use_hartree and eha_res is not None)
-            else rms
-        )
-        res_tol = max(
-            itsol.min_tolerance,
-            min(itsol.tolerance_scale[0] * _m,
-                itsol.tolerance_scale[1] * res_tol),
-        )
+        res_tol = schedule_res_tol(itsol, res_tol, dens_metric, nel,
+                                   mixer.use_hartree and eha_res is not None)
         rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, lam_mixed = unpack(x_mix)
         if lam_mixed is not None:
             hub_lagrange = lam_mixed  # quasi-Newton-mixed multipliers

@@ -27,7 +27,7 @@ from sirius_tpu.dft.density import (
     symmetrize_density_matrix_nc,
     symmetrize_pw,
 )
-from sirius_tpu.dft.mixer import Mixer
+from sirius_tpu.dft.mixer import Mixer, schedule_res_tol
 from sirius_tpu.dft.occupation import find_fermi
 from sirius_tpu.dft.potential_nc import (
     generate_potential_nc,
@@ -276,16 +276,8 @@ def run_scf_nc(
         dens_metric = (
             eha_res if (mixer.use_hartree and eha_res is not None) else rms
         )
-        _m = (
-            dens_metric / max(1.0, nel)
-            if (mixer.use_hartree and eha_res is not None)
-            else rms
-        )
-        res_tol = max(
-            itsol.min_tolerance,
-            min(itsol.tolerance_scale[0] * _m,
-                itsol.tolerance_scale[1] * res_tol),
-        )
+        res_tol = schedule_res_tol(itsol, res_tol, dens_metric, nel,
+                                   mixer.use_hartree and eha_res is not None)
         rho_g, mvec_g = unpack(x_mix)
 
         def _epot(r_out, m_out, p_):

@@ -1,0 +1,277 @@
+"""Gamma-point real-storage band solve (the reference's "Gamma trick").
+
+At k = 0 the Bloch coefficients of a real-in-r wave function obey
+c(-G) = conj(c(G)); the reference exploits this with half-G storage and
+real GEMMs (src/core/wf/wave_functions.hpp:1589-1626, 1683-1696
+`reduce_gvec`, and the SPLA real-GEMM path). The TPU-native form chosen
+here keeps the SAME array length but re-bases it to REAL numbers:
+
+  x = [ c(0),  sqrt(2) Re c(G_1..G_P),  sqrt(2) Im c(G_1..G_P) ]
+
+over one representative G of each (G, -G) pair. The map is an isometry:
+sum_slots x_a x_b == Re <a|b> of the full complex sphere, so EVERY inner
+product, Rayleigh-Ritz block, residual norm and preconditioner step of the
+generic fixed-shape solver (solvers/davidson.py) works unchanged on these
+real vectors — the subspace eigenproblems become real-symmetric (syevd
+instead of heevd) and the big band-block GEMMs become real (4x fewer real
+multiplies on the MXU than complex at equal slot count).
+
+The H application unpacks to the complex sphere with pure gathers (no
+matmul), runs the same FFT-multiply-FFT local pipeline (the box field is
+Hermitian-symmetric, so the real part is taken before the potential
+multiply), and re-packs. Beta projectors are packed once with the same
+isometry, making <beta|psi> and the D/Q expansions real GEMMs too.
+
+Eligibility (wired in dft/scf.run_scf): Gamma-only k-set, no Hubbard
+(complex per-k U apply), no mGGA, no G-sharding. Collinear spins are fine
+(per-spin solve).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SQRT2 = np.sqrt(2.0)
+
+
+class GammaMap(NamedTuple):
+    """Host-side pairing of the Gamma G-sphere (built once per context).
+
+    Sphere-array index spaces: `rep`/`par` index into the ngk sphere
+    arrays; packed layout is [zero | P representatives (Re) | P (Im)]."""
+
+    zero: int  # sphere index of G = 0
+    rep: np.ndarray  # [P] sphere index of each pair representative
+    par: np.ndarray  # [P] sphere index of the partner -G
+    # gather maps for device-side unpack (length ngk, sphere order):
+    slot_re: np.ndarray  # packed slot holding Re of this G (or c0)
+    slot_im: np.ndarray  # packed slot holding Im of this G (self for G=0)
+    im_sign: np.ndarray  # +1 rep, -1 partner, 0 for G = 0
+    scale: np.ndarray  # 1/sqrt2 for pairs, 1 for G = 0
+
+
+def build_gamma_map(millers: np.ndarray, mask: np.ndarray) -> GammaMap:
+    """millers: [ngk, 3] integer G of the Gamma sphere (valid where mask).
+
+    Padded slots (mask == 0) are treated as extra 'zero' singletons mapped
+    onto themselves with im_sign 0 — they stay exactly zero through the
+    solve (the packed mask kills them)."""
+    ngk = len(millers)
+    valid = mask > 0
+    index_of = {}
+    for i in range(ngk):
+        if valid[i]:
+            index_of[tuple(int(v) for v in millers[i])] = i
+    zero = index_of[(0, 0, 0)]
+    rep, par = [], []
+    seen = np.zeros(ngk, dtype=bool)
+    seen[zero] = True
+    for i in range(ngk):
+        if seen[i] or not valid[i]:
+            continue
+        m = tuple(int(v) for v in millers[i])
+        j = index_of.get((-m[0], -m[1], -m[2]))
+        if j is None:
+            raise ValueError(f"Gamma sphere not inversion-closed at G={m}")
+        rep.append(i)
+        par.append(j)
+        seen[i] = seen[j] = True
+    rep = np.asarray(rep, dtype=np.int32)
+    par = np.asarray(par, dtype=np.int32)
+    P = len(rep)
+    slot_re = np.zeros(ngk, dtype=np.int32)
+    slot_im = np.zeros(ngk, dtype=np.int32)
+    im_sign = np.zeros(ngk)
+    scale = np.ones(ngk)
+    slot_re[zero] = 0
+    slot_im[zero] = 0
+    slot_re[rep] = 1 + np.arange(P)
+    slot_im[rep] = 1 + P + np.arange(P)
+    im_sign[rep] = 1.0
+    scale[rep] = 1.0 / SQRT2
+    slot_re[par] = 1 + np.arange(P)
+    slot_im[par] = 1 + P + np.arange(P)
+    im_sign[par] = -1.0
+    scale[par] = 1.0 / SQRT2
+    # padded slots: park them on their own packed positions past the data
+    # region if any exist (ngk > 1 + 2P), else they'd alias slot 0
+    pad = np.where(~valid)[0]
+    if len(pad):
+        base = 1 + 2 * P
+        extra = base + np.arange(len(pad))
+        if extra.max() >= ngk:
+            raise ValueError("padded Gamma sphere inconsistent with pairing")
+        slot_re[pad] = extra
+        slot_im[pad] = extra
+        im_sign[pad] = 0.0
+        scale[pad] = 0.0
+    return GammaMap(
+        zero=int(zero), rep=rep, par=par, slot_re=slot_re,
+        slot_im=slot_im, im_sign=im_sign, scale=scale,
+    )
+
+
+def pack(gm: GammaMap, c: np.ndarray) -> np.ndarray:
+    """Complex sphere coefficients [..., ngk] -> packed real [..., ngk].
+
+    Projects onto the Gamma-symmetric subspace (c(-G) := conj(c(G)) is
+    enforced by construction, arbitrary input allowed)."""
+    ngk = c.shape[-1]
+    out = np.zeros(c.shape[:-1] + (ngk,), dtype=np.float64)
+    out[..., 0] = np.real(c[..., gm.zero])
+    # average the pair to make the projection exact for asymmetric input
+    avg = 0.5 * (c[..., gm.rep] + np.conj(c[..., gm.par]))
+    out[..., 1 : 1 + len(gm.rep)] = SQRT2 * np.real(avg)
+    out[..., 1 + len(gm.rep) : 1 + 2 * len(gm.rep)] = SQRT2 * np.imag(avg)
+    return out
+
+
+def unpack(gm: GammaMap, x: np.ndarray) -> np.ndarray:
+    """Packed real [..., ngk] -> complex sphere coefficients [..., ngk]."""
+    xr = np.take(x, gm.slot_re, axis=-1)
+    xi = np.take(x, gm.slot_im, axis=-1)
+    return gm.scale * (xr + 1j * gm.im_sign * xi)
+
+
+class GammaParams(NamedTuple):
+    """Pytree for the packed-real H/S application at Gamma."""
+
+    veff_r: jax.Array  # [n1,n2,n3] real
+    ekin_p: jax.Array  # [ngk] kinetic at each packed slot's G
+    mask_p: jax.Array  # [ngk] packed validity mask
+    fft_index: jax.Array  # [ngk] sphere scatter index (full set)
+    slot_re: jax.Array  # [ngk] gather maps (sphere order)
+    slot_im: jax.Array
+    im_sign: jax.Array
+    scale: jax.Array
+    zero_idx: jax.Array  # scalar: sphere position of G = 0
+    beta_p: jax.Array  # [nbeta, ngk] packed real projectors
+    dion: jax.Array  # [nbeta, nbeta] real
+    qmat: jax.Array  # [nbeta, nbeta] real
+
+
+def make_gamma_params(ctx, veff_r_coarse, gm: GammaMap, dmat=None,
+                      rdtype=jnp.float64):
+    """Build GammaParams for ik = 0 of a Gamma-only context. Constant
+    tables (beta_p, gather maps, ekin) depend only on (ctx, rdtype) —
+    callers should build once and `_replace(veff_r=..., dion=...)` per
+    iteration (see run_scf's gamma branch)."""
+    nbeta = ctx.beta.num_beta_total
+    ngk = ctx.gkvec.ngk_max
+    ekin = ctx.gkvec.kinetic()[0]
+    # packed-slot kinetic: slot 0 -> G=0, Re/Im slots -> their pair's G
+    ekin_p = np.zeros(ngk)
+    ekin_p[0] = ekin[gm.zero]
+    P = len(gm.rep)
+    ekin_p[1 : 1 + P] = ekin[gm.rep]
+    ekin_p[1 + P : 1 + 2 * P] = ekin[gm.rep]
+    mask_p = np.zeros(ngk)
+    mask_p[: 1 + 2 * P] = 1.0
+    if nbeta:
+        beta_p = pack(gm, np.asarray(ctx.beta.beta_gk[0]))
+    else:
+        beta_p = np.zeros((0, ngk))
+    qmat = ctx.beta.qmat if ctx.beta.qmat is not None else np.zeros((nbeta, nbeta))
+    dmat = ctx.beta.dion if dmat is None else dmat
+    return GammaParams(
+        veff_r=jnp.asarray(veff_r_coarse, dtype=rdtype),
+        ekin_p=jnp.asarray(ekin_p, dtype=rdtype),
+        mask_p=jnp.asarray(mask_p, dtype=rdtype),
+        fft_index=jnp.asarray(ctx.gkvec.fft_index[0]),
+        slot_re=jnp.asarray(gm.slot_re),
+        slot_im=jnp.asarray(gm.slot_im),
+        im_sign=jnp.asarray(gm.im_sign, dtype=rdtype),
+        scale=jnp.asarray(gm.scale, dtype=rdtype),
+        zero_idx=jnp.asarray(gm.zero),
+        beta_p=jnp.asarray(beta_p, dtype=rdtype),
+        dion=jnp.asarray(np.real(dmat), dtype=rdtype),
+        qmat=jnp.asarray(np.real(qmat), dtype=rdtype),
+    )
+
+
+def pack_diags(gm: GammaMap, h_diag: np.ndarray, o_diag: np.ndarray):
+    """Preconditioner diagonals in packed order (values follow each slot's
+    G; the packed H/S diagonals are exactly these by the isometry)."""
+    P = len(gm.rep)
+    hp = np.full_like(h_diag, 1e4)
+    op = np.ones_like(o_diag)
+    hp[0] = h_diag[gm.zero]
+    op[0] = o_diag[gm.zero]
+    hp[1 : 1 + P] = h_diag[gm.rep]
+    op[1 : 1 + P] = o_diag[gm.rep]
+    hp[1 + P : 1 + 2 * P] = h_diag[gm.rep]
+    op[1 + P : 1 + 2 * P] = o_diag[gm.rep]
+    return hp, op
+
+
+def apply_h_s_gamma(params: GammaParams, x: jax.Array):
+    """(H x, S x) for a packed-real band block x [nb, ngk]."""
+    dims = params.veff_r.shape
+    n = dims[0] * dims[1] * dims[2]
+    x = x * params.mask_p
+    batch = x.shape[:-1]
+    cdtype = jnp.complex64 if x.dtype == jnp.float32 else jnp.complex128
+    # unpack to the complex sphere with gathers; lax.complex keeps the
+    # working precision (a bare `1j *` would promote f32 -> c128, which the
+    # TPU backend rejects)
+    xr = jnp.take(x, params.slot_re, axis=-1)
+    xi = jnp.take(x, params.slot_im, axis=-1)
+    c = jax.lax.complex(params.scale * xr, params.scale * params.im_sign * xi)
+    assert c.dtype == cdtype, (c.dtype, cdtype)
+    box = jnp.zeros(batch + (n,), dtype=cdtype).at[..., params.fft_index].add(c)
+    fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1))
+    # Hermitian-symmetric coefficients -> real field: drop the rounding-
+    # level imaginary part BEFORE the potential multiply (real multiply)
+    vr = jnp.real(fr) * params.veff_r
+    vg = (
+        jnp.fft.fftn(jax.lax.complex(vr, jnp.zeros_like(vr)), axes=(-3, -2, -1))
+        .reshape(batch + (n,))[..., params.fft_index]
+    )
+    # re-pack v(G): slot0 = v(0); Re/Im slots via the same isometry
+    vpack = _pack_device(vg, params.slot_re, params.slot_im, params.im_sign,
+                         params.scale, params.zero_idx, x.shape[-1])
+    ekin = jnp.where(params.mask_p > 0, params.ekin_p, 0.0)
+    hx = ekin * x + vpack
+    sx = x
+    if params.beta_p.shape[0]:
+        bp = jnp.einsum("xg,bg->bx", params.beta_p, x)
+        hx = hx + jnp.einsum("bx,xy,yg->bg", bp, params.dion, params.beta_p)
+        sx = sx + jnp.einsum("bx,xy,yg->bg", bp, params.qmat, params.beta_p)
+    return hx * params.mask_p, sx * params.mask_p
+
+
+def _pack_device(vg, slot_re, slot_im, im_sign, scale, zero_idx, npack):
+    """Scatter the complex sphere array vg [..., ngk] into packed real
+    slots. Each packed Re/Im slot receives contributions from BOTH pair
+    members; averaging them (0.5 * sum of the two isometry images) is
+    exact for Hermitian-symmetric vg and projects out rounding noise:
+    Re v(-G) = Re v(G), Im v(-G) = -Im v(G) (the im_sign gather aligns
+    the two)."""
+    w = jnp.where(scale > 0, 1.0, 0.0)
+    re_part = 0.5 * SQRT2 * jnp.real(vg) * w
+    im_part = 0.5 * SQRT2 * jnp.imag(vg) * im_sign * w
+    out = jnp.zeros(vg.shape[:-1] + (npack,), dtype=re_part.dtype)
+    out = out.at[..., slot_re].add(re_part)
+    out = out.at[..., slot_im].add(im_part)
+    # slot 0 was filled by the G=0 re-scatter at sqrt2/2 weight (and 0 from
+    # the im-scatter) — overwrite with the exact real value
+    zero_val = jnp.take(jnp.real(vg), zero_idx, axis=-1)
+    return out.at[..., 0].set(zero_val)
+
+
+@partial(jax.jit, static_argnames=("num_steps",))
+def davidson_gamma(params: GammaParams, x0, h_diag_p, o_diag_p,
+                   num_steps: int = 20, res_tol: float = 1e-6):
+    """Jit wrapper: the generic fixed-shape solver on packed real arrays
+    (subspace blocks become real-symmetric; GEMMs real)."""
+    from sirius_tpu.solvers.davidson import davidson
+
+    return davidson(
+        apply_h_s_gamma, params, x0, h_diag_p, o_diag_p, params.mask_p,
+        num_steps=num_steps, res_tol=res_tol,
+    )
