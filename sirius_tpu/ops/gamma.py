@@ -252,9 +252,12 @@ def _pack_device(vg, slot_re, slot_im, im_sign, scale, zero_idx, npack):
     exact for Hermitian-symmetric vg and projects out rounding noise:
     Re v(-G) = Re v(G), Im v(-G) = -Im v(G) (the im_sign gather aligns
     the two)."""
+    # NOTE float(...) keeps the scalar weakly typed: a bare np.float64
+    # scalar would promote the whole f32 pipeline to f64
+    half_sqrt2 = float(0.5 * SQRT2)
     w = jnp.where(scale > 0, 1.0, 0.0)
-    re_part = 0.5 * SQRT2 * jnp.real(vg) * w
-    im_part = 0.5 * SQRT2 * jnp.imag(vg) * im_sign * w
+    re_part = half_sqrt2 * jnp.real(vg) * w
+    im_part = half_sqrt2 * jnp.imag(vg) * im_sign * w
     out = jnp.zeros(vg.shape[:-1] + (npack,), dtype=re_part.dtype)
     out = out.at[..., slot_re].add(re_part)
     out = out.at[..., slot_im].add(im_part)
@@ -275,3 +278,20 @@ def davidson_gamma(params: GammaParams, x0, h_diag_p, o_diag_p,
         apply_h_s_gamma, params, x0, h_diag_p, o_diag_p, params.mask_p,
         num_steps=num_steps, res_tol=res_tol,
     )
+
+
+@jax.jit
+def density_gamma(params: GammaParams, x: jax.Array, occ_w: jax.Array):
+    """Coarse-box density sum_b occ_w[b] |psi_b(r)|^2 from a packed-real
+    band block x [nb, ngk] (Gamma-only k-set; occ_w includes the k-weight
+    and max_occupancy). Returns [n1, n2, n3] real."""
+    dims = params.veff_r.shape
+    n = dims[0] * dims[1] * dims[2]
+    x = x * params.mask_p
+    xr = jnp.take(x, params.slot_re, axis=-1)
+    xi = jnp.take(x, params.slot_im, axis=-1)
+    c = jax.lax.complex(params.scale * xr, params.scale * params.im_sign * xi)
+    box = jnp.zeros(x.shape[:-1] + (n,), dtype=c.dtype).at[..., params.fft_index].add(c)
+    fr = jnp.fft.ifftn(box.reshape(x.shape[:-1] + dims), axes=(-3, -2, -1)) * n
+    # Hermitian coefficients -> real field; |Re|^2 drops only rounding noise
+    return jnp.einsum("b,bxyz->xyz", occ_w, jnp.real(fr) ** 2)
