@@ -125,8 +125,13 @@ def main() -> None:
             rec = run_deck(name)
         except Exception as e:  # record failures honestly
             rec = {"deck": name, "pass": False, "error": f"{type(e).__name__}: {e}"}
-        existing[name] = rec
         print(json.dumps(rec, indent=1), flush=True)
+        # merge-on-write: re-read the artifact so concurrent deck runners
+        # (long background queues) don't clobber each other's records with
+        # their startup snapshots
+        if os.path.exists(out_path):
+            existing = {r["deck"]: r for r in json.load(open(out_path))["decks"]}
+        existing[name] = rec
         json.dump(
             {"decks": sorted(existing.values(), key=lambda r: r["deck"])},
             open(out_path, "w"), indent=1,
