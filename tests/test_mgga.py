@@ -137,6 +137,10 @@ def test_scan_scf_smoke():
     cfg = load_config(base + "/sirius.json")
     cfg.parameters.xc_functionals = ["XC_MGGA_X_SCAN", "XC_MGGA_C_SCAN"]
     cfg.parameters.num_dft_iter = 5
+    # the deck prints forces/stress; mGGA stress is an explicit
+    # NotImplementedError scope guard and not this smoke's subject
+    cfg.control.print_stress = False
+    cfg.control.print_forces = False
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         res = run_scf(cfg, base_dir=base)
