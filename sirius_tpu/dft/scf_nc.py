@@ -264,8 +264,22 @@ def run_scf_nc(
         if not np.all(np.isfinite(evals)) or not np.isfinite(
             np.sum(np.abs(rho_new))
         ):
+            bad = [
+                name
+                for name, a in [
+                    ("evals", evals),
+                    ("rho_new", rho_new),
+                    ("mvec_new", mvec_new),
+                    ("veff_in", np.asarray(pot.veff_boxes)),
+                    ("bvec_in", np.asarray(pot.bvec_g)),
+                    ("rho_in", rho_g),
+                    ("mvec_in", mvec_g),
+                ]
+                if not np.all(np.isfinite(np.asarray(a)))
+            ]
             raise FloatingPointError(
-                f"non-collinear SCF diverged at iteration {it + 1}"
+                f"non-collinear SCF diverged at iteration {it + 1}: "
+                f"non-finite {bad}"
             )
         x_new = pack(rho_new, mvec_new)
         rms = mixer.rms(x_mix, x_new)

@@ -51,9 +51,13 @@ def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6)
     smax = jnp.max(jnp.abs(s))
     # rank cutoff must scale with the working precision: eigh noise sits at
     # ~eps*smax (1e-7 for c64), so a fixed 1e-13 would rsqrt-amplify noise
-    # directions in single precision
+    # directions in single precision. The floor also bounds the rsqrt
+    # amplification to ~3e5: directions barely above eps*smax get blended
+    # with ~1e7 coefficients whose cancellation error feeds back through
+    # the carried H X blocks and can blow the iteration up (observed with
+    # exactly-degenerate Kramers pairs in the SO spinor solve)
     eps = jnp.finfo(ssub.real.dtype).eps
-    good = s > 50.0 * eps * smax
+    good = s > jnp.maximum(50.0 * eps, 1e-11) * smax
     t = u * jnp.where(good, jax.lax.rsqrt(jnp.where(good, s, 1.0)), 0.0)[None, :]
     at = t.conj().T @ hsub @ t
     at = at + jnp.diag(jnp.where(good, 0.0, big).astype(at.dtype))
@@ -115,8 +119,14 @@ def davidson(
 
     def step(carry, _):
         x, hx, sx, p, hp, sp = carry
-        # Ritz values of current block (H X, S X carried, no re-application)
-        evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
+        # Ritz values of current block (H X, S X carried, no re-application).
+        # Guard the quotient: a rank-deficient Rayleigh-Ritz (heavy Kramers
+        # degeneracy + locking) can hand back a ~zero Ritz vector, and a
+        # 0/0 here NaN-poisons the whole scan (observed: Au SO spinor solve)
+        den = jnp.real(jnp.sum(x.conj() * sx, axis=1))
+        evals = jnp.real(jnp.sum(x.conj() * hx, axis=1)) / jnp.where(
+            jnp.abs(den) > 1e-30, den, 1.0
+        )
         r = (hx - evals[:, None] * sx) * mask
         rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(r) ** 2, axis=1)))
         conv = rnorm < res_tol
@@ -168,9 +178,12 @@ def davidson(
     # fresh application for the exit values: the carried H X accumulates
     # linear-combination rounding (matters in c64)
     hx, sx = apply_h_s(x)
-    evals = jnp.real(jnp.sum(x.conj() * hx, axis=1) / jnp.sum(x.conj() * sx, axis=1))
+    den = jnp.real(jnp.sum(x.conj() * sx, axis=1))
+    evals = jnp.real(jnp.sum(x.conj() * hx, axis=1)) / jnp.where(
+        jnp.abs(den) > 1e-30, den, 1.0
+    )
     rnorm = jnp.sqrt(jnp.real(jnp.sum(jnp.abs(hx - evals[:, None] * sx) ** 2, axis=1)))
-    # normalize to <x|S|x> = 1
-    nrm = jnp.real(jnp.sum(x.conj() * sx, axis=1))
-    x = x / jnp.sqrt(nrm)[:, None]
+    # normalize to <x|S|x> = 1 (den floored: a zero Ritz vector must come
+    # back as a zero row, not NaN/Inf)
+    x = x / jnp.sqrt(jnp.maximum(den, 1e-30))[:, None]
     return evals, x, rnorm
