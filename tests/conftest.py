@@ -7,9 +7,14 @@ configuration (JAX_PLATFORMS / XLA_FLAGS) is too late; jax.config still works
 because no backend has been initialized yet."""
 
 import os
+import sys
 
 import jax
 import pytest
+
+# repo root on sys.path: the editable install has vanished between sessions
+# before (transient env resets); the suite must not depend on it
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force CPU: the suite needs f64/c128 (unsupported on TPU) and a virtual
 # multi-device mesh. Set SIRIUS_TPU_TEST_PLATFORM to override.
