@@ -31,6 +31,32 @@ import time
 
 REF_ITER_TIME_S = 6.325581577 / 30  # test08 scf_time / num_scf_iterations
 
+# Large-tier anchor from the published Si511Ge time-to-solution table
+# (BASELINE.md: 9 XC50 nodes x 214 s, QE+SIRIUS GPU): node-seconds scaled to
+# the 54-atom bench cell by the cubic cost law and divided by an assumed
+# 20-iteration SCF (the published number is time-to-solution; the iteration
+# count is not in-tree). vs_baseline = anchor / measured — honest in order of
+# magnitude, not a calibrated per-iteration figure.
+SI511GE_NODE_S = 214.0 * 9
+SI511GE_ASSUMED_ITERS = 20.0
+LARGE_ANCHOR_S = (
+    SI511GE_NODE_S / SI511GE_ASSUMED_ITERS * (54.0 / 512.0) ** 3
+)
+
+# nominal fp32 peak GFLOPS per accelerator class for the MFU figure
+# (override with BENCH_PEAK_GFLOPS when the actual chip is known):
+# TPU v5p-class 229.5e3 (half the 459e3 bf16 MXU peak), P100 9.3e3 (the
+# BASELINE.md anchor GPU), CPU ~76.8/core (24 f32 FLOP/cycle @ 3.2 GHz)
+def _peak_gflops(platform: str) -> float:
+    env = os.environ.get("BENCH_PEAK_GFLOPS")
+    if env:
+        return float(env)
+    return {
+        "tpu": 229.5e3,
+        "gpu": 9.3e3,
+        "cuda": 9.3e3,
+    }.get(platform, 76.8 * (os.cpu_count() or 1))
+
 
 def _probe(platform: str) -> None:
     """Trivial jit: proves the compile service is alive (subprocess entry)."""
@@ -148,10 +174,14 @@ def _workload(tier: str, platform: str) -> None:
 
         # params as jit ARGUMENTS (real leaves only): closure capture would
         # embed device arrays as program constants; argument passing keeps
-        # buffers device-side. The 3rd argument only keeps the chained
-        # timed_block feeding convention of the complex tiers.
-        @jax.jit
-        def one_iter(ps, x, _unused):
+        # buffers device-side. The psi carry is DONATED — the chained
+        # timed_block feeds each call's subspace into the next, so XLA can
+        # reuse the [nb, ngk] buffer in place (same convention as the fused
+        # SCF carry in dft/fused.py).
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def one_iter(ps, x):
             ev, x2, rn = davidson(
                 apply_h_s_gamma, ps, x, hd, od, ps.mask_p,
                 num_steps=num_steps,
@@ -160,10 +190,10 @@ def _workload(tier: str, platform: str) -> None:
                 ev[None, None], kw, nel, 0.025, max_occupancy=2.0
             )
             rho = density_gamma(ps, x2, occ[0, 0] * kw[0])
-            return ev, rn, rho, x2, x2
+            return ev, rn, rho, x2
 
         x0 = pack(gm, psi[0, 0]).astype(np.float32)
-        args = (gparams, jnp.asarray(x0), jnp.asarray(x0))
+        args = (gparams, jnp.asarray(x0))
         label = (
             "SCF-iteration wall time (20-step Gamma real-storage band solve "
             "+ Fermi + density)"
@@ -174,8 +204,9 @@ def _workload(tier: str, platform: str) -> None:
         )
     elif tier == "micro":
         num_steps = 4
+        from functools import partial
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(1, 2))
         def one_iter(ps, pr, pi):
             ev, pr2, pi2, rn = davidson_kset(ps, pr, pi, num_steps=num_steps)
             mu, occ, ent = find_fermi(ev, kw, 8.0, 0.025, max_occupancy=2.0)
@@ -189,12 +220,14 @@ def _workload(tier: str, platform: str) -> None:
         )
         label = "micro SCF-iteration wall time (4-step band solve + Fermi + density, gk=4 nb=8)"
     else:  # "hpsi": raw Hamiltonian application throughput
+        from functools import partial
+
         from sirius_tpu.ops.hamiltonian import apply_h_s
         from sirius_tpu.parallel.batched import hk_complex, hkset_slice_r
 
         slc = hkset_slice_r(params)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(1, 2))
         def one_iter(ps, pr, pi):
             pk = hk_complex(ps)
             def body(c, _):
@@ -213,6 +246,7 @@ def _workload(tier: str, platform: str) -> None:
         )
         label = "62x H*psi application wall time (local+nonlocal, 26 bands)"
 
+    n_carry = len(args) - 1
     t_c0 = time.perf_counter()
     out = one_iter(*args)
     # block_until_ready is NOT a reliable completion barrier on the remote-
@@ -220,18 +254,23 @@ def _workload(tier: str, platform: str) -> None:
     # programs); force completion with a host readback of a real output leaf
     np.asarray(out[0])
     sys.stderr.write(f"[bench] compile+first run: {time.perf_counter()-t_c0:.1f}s\n")
+    # the psi carry was donated: args' input buffers are dead — the chain
+    # state lives in `cur` from here on
+    cur = (args[0], *out[-n_carry:])
 
     def timed_block(reps: int) -> float:
         """reps chained one_iter calls (outputs feed the next call's psi) +
         ONE final readback; the chain defeats async-dispatch undercounting
         and amortizes the tunnel round-trip."""
-        a = args
+        nonlocal cur
+        a = cur
         t0 = time.perf_counter()
         o = None
         for _ in range(reps):
             o = one_iter(*a)
-            a = (a[0], o[-2], o[-1])
+            a = (a[0], *o[-n_carry:])
         np.asarray(o[0])
+        cur = a
         return (time.perf_counter() - t0) / reps
 
     timed_block(1)  # warm the dispatch path
@@ -240,8 +279,15 @@ def _workload(tier: str, platform: str) -> None:
     for i, t in enumerate(times):
         sys.stderr.write(f"[bench] block {i}: {t:.4f}s/iter\n")
     iter_time = float(np.median(times))
-    # the hpsi micro-tier is NOT comparable to the whole-iteration anchor
-    vs = round(REF_ITER_TIME_S / iter_time, 3) if tier == "full" else 0.0
+    # full tier: the reference's own test08 CPU run; large tier: the
+    # published Si511Ge node-seconds scaled to the bench cell (see
+    # LARGE_ANCHOR_S). The micro/hpsi tiers have no comparable anchor.
+    if tier == "full":
+        vs = round(REF_ITER_TIME_S / iter_time, 3)
+    elif tier == "large":
+        vs = round(LARGE_ANCHOR_S / iter_time, 4)
+    else:
+        vs = 0.0
     shapes = {
         "micro": "Si-2atom US gk=4/pw=12 nb=8 c64",
         "large": "Si-54atom US gk=5/pw=15 nb=512 f32-packed",
@@ -261,6 +307,14 @@ def _workload(tier: str, platform: str) -> None:
     gflops = (
         _hpsi_flops(1, ngk, nbeta, box) * n_band_applies / iter_time / 1e9
     )
+    peak = _peak_gflops(plat)
+    extra = {}
+    if tier == "large":
+        extra["baseline_anchor"] = (
+            f"Si511Ge 9-node GPU {SI511GE_NODE_S:.0f} node*s / "
+            f"{SI511GE_ASSUMED_ITERS:.0f} assumed iters * (54/512)^3 = "
+            f"{LARGE_ANCHOR_S:.4f} s (BASELINE.md)"
+        )
     print(
         json.dumps(
             {
@@ -269,6 +323,11 @@ def _workload(tier: str, platform: str) -> None:
                 "unit": "s/iteration",
                 "vs_baseline": vs,
                 "hpsi_gflops_per_chip": round(gflops, 2),
+                # model-flop utilization against the (nominal, overridable)
+                # chip peak — the honest-perf figure VERDICT r5 asked for
+                "mfu": round(gflops / peak, 5),
+                "peak_gflops_assumed": peak,
+                **extra,
                 "flops_model": "per-apply: 10 N log2 N + 7N + 8 ngk + "
                                "8 nb(3 nbeta ngk + 2 nbeta^2), N=coarse box",
                 # CPU-fallback timings are machine-bound: the r03->r04

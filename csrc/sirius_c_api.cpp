@@ -735,7 +735,7 @@ void sirius_get_gkvec_arrays(void* handler, int const* ik, int* num_gkvec, int* 
         PyObject* gc = PyDict_GetItemString(r, "gkvec_cart");
         PyObject* gl = PyDict_GetItemString(r, "gkvec_len");
         PyObject* gt = PyDict_GetItemString(r, "gkvec_tp");
-        if (!ok || !gi || !gf || !gc || !gl || !gt ||
+        if (!ok || n < 0 || !gi || !gf || !gc || !gl || !gt ||
             PyList_Size(gi) < n || PyList_Size(gl) < n ||
             PyList_Size(gf) < 3 * n || PyList_Size(gc) < 3 * n ||
             PyList_Size(gt) < 2 * n) {
@@ -755,6 +755,16 @@ void sirius_get_gkvec_arrays(void* handler, int const* ik, int* num_gkvec, int* 
             for (int x = 0; x < 2; x++) {
                 gkvec_tp[2 * i + x] = PyFloat_AsDouble(PyList_GetItem(gt, 2 * i + x));
             }
+        }
+        if (PyErr_Occurred()) {
+            /* non-numeric element: PyLong_AsLong/PyFloat_AsDouble return -1
+             * with a pending exception — report instead of leaking it into
+             * the caller's next embedded call */
+            PyErr_Clear();
+            set_err(error_code, 1);
+            Py_XDECREF(r);
+            PyGILState_Release(st);
+            return;
         }
         set_err(error_code, 0);
     } else {

@@ -45,6 +45,17 @@ class ControlConfig:
     # G vectors via its MPI fft_mode="parallel" instead)
     gshard: object = "auto"
     gshard_budget_bytes: float = 2.0e9
+    # fused device-resident SCF iteration (dft/fused.py): "auto" engages it
+    # whenever the deck is in the supported regime (PP-PW batched band
+    # solve, no Hubbard/PAW/mGGA, linear/Anderson mixing); False keeps the
+    # per-iteration host path as a debug fallback. sirius_tpu extension.
+    device_scf: object = "auto"
+    # on-the-fly chunked beta projectors (ops/beta_chunked.py): "auto"
+    # switches the band solve to chunk-generated projectors when the dense
+    # [nbeta_total, ngk] table would exceed beta_chunk_budget_bytes; True
+    # forces, False disables. sirius_tpu extension.
+    beta_chunked: object = "auto"
+    beta_chunk_budget_bytes: float = 2.0e9
 
 
 @dataclasses.dataclass

@@ -318,6 +318,92 @@ def atomic_moments(ctx: SimulationContext, mag_g: np.ndarray) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Device-resident symmetrization (jit twins of symmetrize_pw /
+# symmetrize_density_matrix for the fused SCF step). The host variants keep
+# python loops over ops with np.add.at; on device the rotation tables become
+# dense [nops, ...] arrays built once, and the op loop becomes one batched
+# gather-scatter / einsum inside the compiled program.
+# ---------------------------------------------------------------------------
+
+
+def build_sym_pw_tables(ctx: SimulationContext):
+    """Dense per-op PW rotation tables for symmetrize_pw_device:
+    (idx [nops, ng] int32, phase_re/phase_im [nops, ng], ssign [nops]).
+    Reuses (and fills) the same _sym_rot_cache the host path uses."""
+    # prime the cache through the host function (identity op is cheap)
+    if getattr(ctx, "_sym_rot_cache", None) is None:
+        symmetrize_pw(ctx, np.zeros(ctx.gvec.num_gvec, dtype=np.complex128))
+    idx = np.stack([c[0] for c in ctx._sym_rot_cache]).astype(np.int32)
+    phase = np.stack([c[1] for c in ctx._sym_rot_cache])
+    ssign = np.array([c[2] for c in ctx._sym_rot_cache], dtype=np.float64)
+    return {
+        "idx": idx,
+        "phase_re": np.real(phase),
+        "phase_im": np.imag(phase),
+        "ssign": ssign,
+    }
+
+
+def symmetrize_pw_device(f_g: jnp.ndarray, tb: dict,
+                         axial_z: bool = False) -> jnp.ndarray:
+    """Jit-safe symmetrize_pw: f_g complex [ng] (inside the compiled
+    program), tb from build_sym_pw_tables as device arrays."""
+    nops = tb["idx"].shape[0]
+    phase = jax.lax.complex(tb["phase_re"], tb["phase_im"])
+    if axial_z:
+        phase = phase * tb["ssign"][:, None]
+    vals = f_g[None, :] * phase
+    out = jnp.zeros_like(f_g).at[tb["idx"].reshape(-1)].add(vals.reshape(-1))
+    return out / nops
+
+
+def build_dm_sym_tables(ctx: SimulationContext):
+    """Per-op dense beta-rotation matrices for the collinear density-matrix
+    symmetrization: S_op[nops, nbeta, nbeta] with
+    S[joff + i, off + j] = r[i, j] (joff the permuted atom's block), so
+    dm' = (1/N) sum_op S dm S^T reproduces symmetrize_density_matrix's
+    per-block r @ dm_block @ r.T scattered to the permuted block. flipneg
+    marks ops with spin_sign < 0 (collinear channel swap); blockmask zeroes
+    the inter-atom blocks the host variant never writes."""
+    sym = ctx.symmetry
+    uc = ctx.unit_cell
+    nbeta = ctx.beta.num_beta_total
+    blocks = list(ctx.beta.atom_blocks(uc))
+    off_by_atom = {ia: off for ia, off, _ in blocks}
+    ops = sym.ops if sym is not None and sym.num_ops > 1 else []
+    s_ops = np.zeros((max(len(ops), 1), nbeta, nbeta))
+    flipneg = np.zeros(max(len(ops), 1), dtype=bool)
+    if not ops:
+        s_ops[0] = np.eye(nbeta)
+    for io, op in enumerate(ops):
+        rot_by_type = _beta_rotation_blocks(ctx, op)
+        flipneg[io] = op.spin_sign < 0
+        for ia, off, nbf in blocks:
+            r = rot_by_type[uc.type_of_atom[ia]]
+            joff = off_by_atom[int(op.perm[ia])]
+            s_ops[io, joff : joff + nbf, off : off + nbf] = r
+    blockmask = np.zeros((nbeta, nbeta))
+    for _, off, nbf in blocks:
+        blockmask[off : off + nbf, off : off + nbf] = 1.0
+    return {"s_ops": s_ops, "flipneg": flipneg, "blockmask": blockmask}
+
+
+def symmetrize_density_matrix_device(dm: jnp.ndarray, tb: dict) -> jnp.ndarray:
+    """Jit-safe symmetrize_density_matrix: dm complex [ns, nbeta, nbeta]
+    inside the compiled program, tb from build_dm_sym_tables. For ns == 2
+    the spin channels swap under flipneg ops exactly like the host."""
+    ns = dm.shape[0]
+    nops = tb["s_ops"].shape[0]
+    if ns == 2:
+        dms = jnp.where(tb["flipneg"][:, None, None, None],
+                        dm[None, ::-1], dm[None])
+    else:
+        dms = jnp.broadcast_to(dm[None], (nops,) + dm.shape)
+    out = jnp.einsum("oij,osjk,olk->sil", tb["s_ops"], dms, tb["s_ops"])
+    return out * tb["blockmask"][None] / nops
+
+
 def atomic_moments_vec(ctx: SimulationContext, mvec_g: np.ndarray) -> np.ndarray:
     """Per-atom (mx, my, mz) sphere integrals — vector form of
     atomic_moments for non-collinear runs. mvec_g: [3, ng]."""

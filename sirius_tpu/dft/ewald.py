@@ -9,6 +9,12 @@ with S(G) = sum_i z_i e^{i G r_i} and N_el = sum_i z_i (neutral cell).
 The splitting parameter follows the reference's adaptive choice
 (simulation_context.cpp:130): start at lambda = 1 and increase/decrease by
 x2 until the G-space tail at pw_cutoff is below 1e-16.
+
+The Ewald energy depends only on the lattice and ion positions, so it is
+computed ONCE on the host at context creation (SimulationContext.e_ewald)
+and hoisted out of the SCF loop entirely: the fused device-resident
+iteration (dft/fused.py) folds it into the total energy as a compile-time
+constant rather than re-evaluating or transferring it per iteration.
 """
 
 from __future__ import annotations

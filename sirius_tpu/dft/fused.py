@@ -1,0 +1,343 @@
+"""Device-resident SCF iteration: density -> potential -> mixer fused into
+one compiled XLA program.
+
+The host loop in dft/scf.py historically round-tripped the full G-sphere
+density, potential and mixer history through numpy every iteration. On TPU
+that per-iteration host traffic (plus the numpy Anderson solve) dominates
+wall time once the band solve itself is compiled. This module packages the
+entire post-band-solve pipeline
+
+  coarse |psi|^2 accumulation -> fine-G density (+ ultrasoft augmentation,
+  + point-group symmetrization) -> mixer (linear / Anderson) -> Hartree +
+  XC + local potential assembly -> D-operator + H-diagonal refresh
+
+as one jitted step over a donated carry (FusedCarry), so the only thing
+fetched to the host per iteration is a [NUM_SCALARS] vector of convergence
+and energy scalars. Everything obeys the real-boundary contract of
+parallel/batched.py: the carry and all step outputs are REAL leaves —
+(re, im) pairs for complex quantities — and complex dtypes exist only
+inside the compiled program.
+
+The Ewald energy and all geometry tables are hoisted: built once on the
+host at FusedScf construction and uploaded as a constant pytree of device
+arrays (`self.tables`), passed (not closed over) so the executable does not
+embed them.
+
+Selection: run_scf uses this path when control.device_scf is "auto"/true
+and the deck is in the supported regime (PP-PW, no Hubbard/PAW/mGGA, plain
+or Anderson mixing, batched k-set band solve). control.device_scf = false
+keeps the host path — bit-identical to the pre-fusion code — as the debug
+fallback; tests/test_fused_scf.py pins the two paths to ~1e-8 Ha agreement.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sirius_tpu.core.fftgrid import r_to_g
+from sirius_tpu.dft.density import (
+    build_dm_sym_tables,
+    build_sym_pw_tables,
+    symmetrize_density_matrix_device,
+    symmetrize_pw_device,
+)
+from sirius_tpu.dft.mixer import (
+    DeviceMixerState,
+    device_mix,
+    device_mixer_init,
+    device_mixer_weights,
+)
+from sirius_tpu.dft.potential import (
+    build_potential_device_tables,
+    generate_potential_device,
+)
+from sirius_tpu.ops.augmentation import (
+    build_aug_device_tables,
+    d_operator_device,
+    rho_aug_g_device,
+)
+from sirius_tpu.parallel.batched import compute_h_diag_device, split_cplx
+
+# indices into the per-iteration scalar record (the ONLY device->host
+# traffic of a fused iteration)
+S_RMS = 0  # mixer rms (pre-mix)
+S_EHA = 1  # Hartree energy of the (mixed - new) charge residual
+S_VHA = 2  # int rho v_ha
+S_VXC = 3  # int rho v_xc
+S_VLOC = 4  # int rho v_loc
+S_VEFF = 5  # int rho v_eff
+S_EXC = 6  # int (rho + rho_core) eps_xc
+S_BXC = 7  # int m b_xc
+S_E1 = 8  # E_pot[rho_out] under the OLD potential
+S_E2 = 9  # E_pot[rho_out] under the NEW potential
+S_EVAL = 10  # sum_k w_k occ eps
+S_NEL = 11  # electron count from rho_out (audit)
+S_MAG = 12  # total moment from m_out (pre-mix)
+S_V0 = 13  # Re veff(G=0)
+S_ENT = 14  # smearing entropy sum
+NUM_SCALARS = 15
+
+
+class FusedCarry(NamedTuple):
+    """Donated SCF carry: all-real leaves (the jit-boundary contract)."""
+
+    x_re: jnp.ndarray  # [nx] packed mixed vector (rho fine-G [+ mag])
+    x_im: jnp.ndarray
+    hx_re: jnp.ndarray  # [M, nx] mixer input history
+    hx_im: jnp.ndarray
+    hf_re: jnp.ndarray  # [M, nx] mixer residual history
+    hf_im: jnp.ndarray
+    count: jnp.ndarray  # int32, valid history rows
+    veff_re: jnp.ndarray  # [ng] effective potential (for the e1 term)
+    veff_im: jnp.ndarray
+    bz_re: jnp.ndarray  # [ng] collinear field (zeros when unpolarized)
+    bz_im: jnp.ndarray
+
+
+class FusedScf:
+    """One SCF deck's fused device-resident iteration.
+
+    Construction uploads every geometry/metric table once; step() is the
+    compiled per-iteration program; finalize() is the single end-of-loop
+    host fetch that reconstitutes what the final report needs.
+    """
+
+    def __init__(self, ctx, xc, mixer, polarized: bool, do_symmetrize: bool,
+                 beta_dev=None):
+        self.ctx = ctx
+        self.xc = xc
+        self.polarized = bool(polarized)
+        self.do_symmetrize = bool(do_symmetrize)
+        self.ns = 2 if polarized else 1
+        self.ng = ctx.gvec.num_gvec
+        self.omega = float(ctx.unit_cell.omega)
+        self.dims = tuple(ctx.gvec.fft.dims)
+        self.dims_coarse = tuple(ctx.fft_coarse.dims)
+        self.kind = mixer.kind
+        self.mix_beta = float(mixer.beta)
+        self.max_history = int(mixer.max_history)
+        self.nx = self.ns * self.ng
+        nbeta = ctx.beta.num_beta_total
+        # same gate as the host density/D path: with ctx.aug present the
+        # density matrix is accumulated and D screened even if some species
+        # carry no augmentation (their tables are simply absent)
+        self.has_aug = ctx.aug is not None and nbeta > 0
+
+        tables = {
+            "mixw": device_mixer_weights(mixer),
+            "pot": build_potential_device_tables(ctx),
+            "fft_index_coarse": ctx.gvec_coarse.fft_index,
+            "c2f": ctx.coarse_to_fine,
+            "ekin": np.asarray(ctx.gkvec.kinetic(), dtype=np.float64),
+            "gmask": np.asarray(ctx.gkvec.mask, dtype=np.float64),
+            "dion": np.real(np.asarray(ctx.beta.dion))
+            if nbeta
+            else np.zeros((0, 0)),
+        }
+        if beta_dev is not None:
+            tables["beta_re"], tables["beta_im"] = beta_dev
+        elif nbeta:
+            tables["beta_re"], tables["beta_im"] = split_cplx(
+                np.asarray(ctx.beta.beta_gk)
+            )
+        else:
+            nk = ctx.gkvec.num_kpoints
+            z = np.zeros((nk, 0, ctx.gkvec.ngk_max))
+            tables["beta_re"], tables["beta_im"] = z, z
+        if self.has_aug:
+            tables["aug"] = build_aug_device_tables(
+                ctx.unit_cell, ctx.gvec, ctx.aug, ctx.beta
+            )
+        if self.do_symmetrize:
+            tables["sym"] = build_sym_pw_tables(ctx)
+            tables["dm_sym"] = build_dm_sym_tables(ctx)
+        # one-time upload; step() takes these as an argument so they are
+        # program inputs, not baked-in constants
+        self.tables = jax.tree_util.tree_map(jnp.asarray, tables)
+        self.kweights_dev = jnp.asarray(np.asarray(ctx.kweights))
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    # -- host <-> device edges -------------------------------------------
+
+    def init_carry(self, x_mix: np.ndarray, pot) -> FusedCarry:
+        """Seed the carry from the host-side initial packed vector and the
+        initial potential (generated on the host once, before the loop)."""
+        x_re, x_im = split_cplx(np.asarray(x_mix))
+        st = device_mixer_init(self.nx, self.max_history)
+        v_re, v_im = split_cplx(np.asarray(pot.veff_g))
+        if self.polarized and pot.bz_g is not None:
+            b_re, b_im = split_cplx(np.asarray(pot.bz_g))
+        else:
+            # distinct buffers (donated leaves must not alias)
+            b_re, b_im = np.zeros(self.ng), np.zeros(self.ng)
+        return FusedCarry(
+            jnp.asarray(x_re), jnp.asarray(x_im),
+            st.hx_re, st.hx_im, st.hf_re, st.hf_im, st.count,
+            jnp.asarray(v_re), jnp.asarray(v_im),
+            jnp.asarray(b_re), jnp.asarray(b_im),
+        )
+
+    def step(self, carry, acc, dm_re, dm_im, ev, occ_w, ent):
+        """One fused iteration. acc: [ns, coarse box] occupation-weighted
+        |psi(r)|^2 from density_kset; (dm_re, dm_im): [ns, nbeta, nbeta]
+        from density_matrix_kset (empty for norm-conserving); ev: [nk, ns,
+        nb] float64 eigenvalues; occ_w = occ * kweights; ent: entropy sum.
+        All device arrays. Returns (new_carry, out_dict)."""
+        return self._step(self.tables, carry, acc, dm_re, dm_im, ev,
+                          occ_w, ent)
+
+    def finalize(self, carry, out) -> dict:
+        """The single end-of-loop host fetch: mixed density, D matrices,
+        density-matrix blocks and residual for the final report/forces."""
+        ctx = self.ctx
+        x = np.asarray(carry.x_re) + 1j * np.asarray(carry.x_im)
+        rho_g = x[: self.ng]
+        mag_g = x[self.ng :] if self.polarized else None
+        d_by_spin = list(np.asarray(out["dion"], dtype=np.float64))
+        rho_resid_g = (
+            np.asarray(out["resid_re"]) + 1j * np.asarray(out["resid_im"])
+        )
+        dm_blocks_by_spin = []
+        if self.has_aug:
+            dm = np.asarray(out["dm_re"]) + 1j * np.asarray(out["dm_im"])
+            for ispn in range(self.ns):
+                dm_blocks_by_spin.append([
+                    dm[ispn, off : off + nbf, off : off + nbf]
+                    for _, off, nbf in ctx.beta.atom_blocks(ctx.unit_cell)
+                ])
+        return {
+            "rho_g": rho_g,
+            "mag_g": mag_g,
+            "d_by_spin": d_by_spin,
+            "rho_resid_g": rho_resid_g,
+            "dm_blocks_by_spin": dm_blocks_by_spin,
+        }
+
+    # -- the compiled program --------------------------------------------
+
+    def _step_impl(self, tables, carry, acc, dm_re, dm_im, ev, occ_w, ent):
+        ng, ns, omega = self.ng, self.ns, self.omega
+        cdt = jnp.complex128
+
+        # density_from_coarse_acc, traced: 1/Omega, coarse r -> coarse G,
+        # scatter onto the fine sphere
+        acc = acc.astype(jnp.float64)
+        rho_c = r_to_g(
+            (acc / omega).astype(cdt), tables["fft_index_coarse"],
+            self.dims_coarse,
+        )
+        rho_spin = jnp.zeros((ns, ng), dtype=cdt).at[:, tables["c2f"]].set(
+            rho_c
+        )
+
+        dm = jax.lax.complex(
+            dm_re.astype(jnp.float64), dm_im.astype(jnp.float64)
+        )
+        if self.has_aug:
+            if self.do_symmetrize:
+                dm = symmetrize_density_matrix_device(dm, tables["dm_sym"])
+            rho_spin = rho_spin + rho_aug_g_device(dm, tables["aug"], ng)
+
+        rho_new = jnp.sum(rho_spin, axis=0)
+        mag_new = rho_spin[0] - rho_spin[1] if self.polarized else None
+        nel_got = jnp.real(rho_new[0]) * omega
+        if self.do_symmetrize:
+            rho_new = symmetrize_pw_device(rho_new, tables["sym"])
+            if self.polarized:
+                mag_new = symmetrize_pw_device(
+                    mag_new, tables["sym"], axial_z=True
+                )
+        mag_moment = (
+            jnp.real(mag_new[0]) * omega if self.polarized else jnp.zeros(())
+        )
+
+        # mixing (host-sequence semantics: rms pre-mix, eha post-mix)
+        x_new = (
+            jnp.concatenate([rho_new, mag_new]) if self.polarized else rho_new
+        )
+        x_in = jax.lax.complex(carry.x_re, carry.x_im)
+        state = DeviceMixerState(
+            carry.hx_re, carry.hx_im, carry.hf_re, carry.hf_im, carry.count
+        )
+        state, x_mixed, rms, eha = device_mix(
+            state, x_in, x_new, tables["mixw"], self.mix_beta, self.kind,
+            self.max_history,
+        )
+        resid = rho_new - x_in[:ng]  # output - input density (scf-corr force)
+
+        # Harris term e1 against the potential this iteration's bands saw
+        veff_old = jax.lax.complex(carry.veff_re, carry.veff_im)
+        e1 = jnp.real(jnp.sum(jnp.conj(rho_new) * veff_old)) * omega
+        if self.polarized:
+            bz_old = jax.lax.complex(carry.bz_re, carry.bz_im)
+            e1 = e1 + jnp.real(jnp.sum(jnp.conj(mag_new) * bz_old)) * omega
+
+        # potential from the MIXED density
+        rho_mix = x_mixed[:ng]
+        mag_mix = x_mixed[ng:] if self.polarized else None
+        pot = generate_potential_device(
+            self.xc, rho_mix, mag_mix, tables["pot"], self.dims,
+            self.dims_coarse, omega,
+            sym_tb=tables["sym"] if self.do_symmetrize else None,
+        )
+        veff_new = pot["veff_g"]
+        bz_new = pot["bz_g"]
+        e2 = jnp.real(jnp.sum(jnp.conj(rho_new) * veff_new)) * omega
+        if self.polarized:
+            e2 = e2 + jnp.real(jnp.sum(jnp.conj(mag_new) * bz_new)) * omega
+        v0 = jnp.real(veff_new[0])
+
+        # next iteration's D matrices and H diagonal
+        if self.has_aug:
+            ds = []
+            for s in range(ns):
+                if self.polarized:
+                    vs = veff_new + (bz_new if s == 0 else -bz_new)
+                else:
+                    vs = veff_new
+                ds.append(
+                    d_operator_device(vs, tables["dion"], tables["aug"],
+                                      omega)
+                )
+            dion_new = jnp.stack(ds)
+        else:
+            dion_new = jnp.broadcast_to(
+                tables["dion"][None], (ns,) + tables["dion"].shape
+            )
+        h_diag = compute_h_diag_device(
+            tables["ekin"], tables["gmask"], tables["beta_re"],
+            tables["beta_im"], dion_new, v0,
+        )
+
+        eval_sum = jnp.sum(occ_w * ev)
+        e = pot["energies"]
+        scalars = jnp.stack([
+            rms, eha, e["vha"], e["vxc"], e["vloc"], e["veff"], e["exc"],
+            e["bxc"], e1, e2, eval_sum, nel_got, mag_moment, v0,
+            ent.astype(jnp.float64),
+        ])
+
+        if self.polarized:
+            bz_re, bz_im = jnp.real(bz_new), jnp.imag(bz_new)
+        else:
+            bz_re = bz_im = jnp.zeros(ng)
+        new_carry = FusedCarry(
+            jnp.real(x_mixed), jnp.imag(x_mixed),
+            state.hx_re, state.hx_im, state.hf_re, state.hf_im, state.count,
+            jnp.real(veff_new), jnp.imag(veff_new), bz_re, bz_im,
+        )
+        out = {
+            "scalars": scalars,
+            "veff_r_coarse": pot["veff_r_coarse"],
+            "dion": dion_new,
+            "h_diag": h_diag,
+            "dm_re": jnp.real(dm),
+            "dm_im": jnp.imag(dm),
+            "resid_re": jnp.real(resid),
+            "resid_im": jnp.imag(resid),
+        }
+        return new_carry, out

@@ -21,6 +21,10 @@ Algorithms (all limited-memory quasi-Newton on x_{n+1} = x_n - G_n f_n):
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -231,6 +235,126 @@ class Mixer:
             self._x.pop(0)
             self._f.pop(0)
         return nxt
+
+
+# ---------------------------------------------------------------------------
+# Device-resident mixer (the jitted twin of Mixer for the fused SCF step).
+#
+# The host Mixer above keeps python-list history and runs numpy eigh per
+# call; inside a compiled SCF iteration the history must be fixed-shape
+# device state instead. DeviceMixerState holds a fixed max_history block of
+# (x_in, f) pairs as (re, im) leaves — real leaves only, per the
+# real-boundary contract of parallel/batched.py — plus a fill counter.
+# Unfilled slots stay exactly zero, which makes their residual-difference
+# directions zero vectors: the Gram matrix rows vanish and the same
+# 1e-12 * w_max eigenvalue cut the host _mix_anderson applies drops them,
+# so the masked fixed-shape solve is numerically identical to the host
+# variable-length one (tested in tests/test_fused_scf.py).
+# ---------------------------------------------------------------------------
+
+
+class DeviceMixerState(NamedTuple):
+    """Fixed-shape mixing history: [max_history, nx] real leaves."""
+
+    hx_re: jnp.ndarray
+    hx_im: jnp.ndarray
+    hf_re: jnp.ndarray
+    hf_im: jnp.ndarray
+    count: jnp.ndarray  # int32 scalar, number of valid history rows
+
+
+def device_mixer_init(nx: int, max_history: int,
+                      dtype=jnp.float64) -> DeviceMixerState:
+    # distinct buffers per leaf: the fused carry donates them, and donating
+    # one buffer under several leaves is an XLA error
+    def z():
+        return jnp.zeros((max_history, nx), dtype=dtype)
+
+    return DeviceMixerState(z(), z(), z(), z(), jnp.zeros((), jnp.int32))
+
+
+def device_mixer_weights(mixer: Mixer):
+    """The (weight, rms_weight, eha_weight) triple of a host Mixer as a
+    dict of device arrays, so the fused step mixes in the exact metric the
+    host path uses."""
+    if mixer.weight is None or mixer._eha_w is None:
+        raise ValueError("device mixer needs the G-space metric "
+                         "(construct the host Mixer with glen2/omega)")
+    return {
+        "w": jnp.asarray(mixer.weight),
+        "rms_w": jnp.asarray(mixer.rms_weight),
+        "eha_w": jnp.asarray(np.where(np.isfinite(mixer._eha_w),
+                                      mixer._eha_w, 0.0)),
+    }
+
+
+def device_mix(state: DeviceMixerState, x_in: jnp.ndarray, x_new: jnp.ndarray,
+               weights: dict, beta: float, kind: str, max_history: int):
+    """One mixer update inside jit. x_in/x_new are complex packed vectors
+    (complex exists only inside the compiled program); returns
+    (new_state, x_mixed, rms, eha_res) with rms/eha traced scalars.
+
+    Semantics match the host sequence in run_scf exactly:
+      rms     = Mixer.rms(x_in, x_new)        [before mixing]
+      x_mixed = Mixer.mix(x_in, x_new)
+      eha_res = Mixer.residual_hartree_energy(x_mixed, x_new)
+    """
+    if kind not in ("linear", "anderson"):
+        raise ValueError(f"device mixer supports linear/anderson, got '{kind}'")
+    w = weights["w"]
+    rms_w = weights["rms_w"]
+    eha_w = weights["eha_w"]
+    f = x_new - x_in
+    rms = jnp.sqrt(jnp.maximum(
+        jnp.real(jnp.sum(rms_w * jnp.conj(f) * f)), 0.0))
+
+    if kind == "linear":
+        out = x_in + beta * f
+    else:
+        m = max_history
+        valid = (jnp.arange(m) < state.count)[:, None]
+        hx = jnp.where(valid, jax.lax.complex(state.hx_re, state.hx_im), 0.0)
+        hf = jnp.where(valid, jax.lax.complex(state.hf_re, state.hf_im), 0.0)
+        dfs = jnp.where(valid, f[None, :] - hf, 0.0)
+        dxs = jnp.where(valid, x_in[None, :] - hx, 0.0)
+        a = jnp.real(jnp.einsum("ix,x,jx->ij", jnp.conj(dfs), w, dfs))
+        b = jnp.real(jnp.einsum("ix,x,x->i", jnp.conj(dfs), w, f))
+        ok = jnp.all(jnp.isfinite(a)) & jnp.all(jnp.isfinite(b))
+        a = jnp.where(ok, a, jnp.eye(m, dtype=a.dtype))
+        ew, v = jnp.linalg.eigh(0.5 * (a + a.T))
+        # zero-padded history rows produce exactly-zero eigenvalues; the
+        # host threshold (1e-12 * largest) removes them along with any
+        # numerically collinear directions
+        thresh = 1e-12 * jnp.maximum(ew[-1], 0.0)
+        keep = ew > thresh
+        ew_safe = jnp.where(keep, ew, 1.0)
+        g = v @ (jnp.where(keep, 1.0 / ew_safe, 0.0) * (v.T @ b))
+        g = jnp.where(ok & (state.count > 0), g, 0.0)
+        x_opt = x_in - jnp.einsum("i,ix->x", g.astype(dxs.dtype), dxs)
+        f_opt = f - jnp.einsum("i,ix->x", g.astype(dfs.dtype), dfs)
+        out = x_opt + beta * f_opt
+        out = jnp.where(jnp.all(jnp.isfinite(jnp.real(out))
+                                & jnp.isfinite(jnp.imag(out))),
+                        out, x_in + beta * f)
+
+    # push (x_in, f) into the newest slot; roll the block once full
+    def _push(h_re, h_im, val):
+        full = state.count >= max_history
+        h_re = jnp.where(full, jnp.roll(h_re, -1, axis=0), h_re)
+        h_im = jnp.where(full, jnp.roll(h_im, -1, axis=0), h_im)
+        slot = jnp.minimum(state.count, max_history - 1)
+        return (h_re.at[slot].set(jnp.real(val)),
+                h_im.at[slot].set(jnp.imag(val)))
+    hx_re, hx_im = _push(state.hx_re, state.hx_im, x_in)
+    hf_re, hf_im = _push(state.hf_re, state.hf_im, f)
+    new_state = DeviceMixerState(
+        hx_re, hx_im, hf_re, hf_im,
+        jnp.minimum(state.count + 1, max_history).astype(jnp.int32))
+
+    n = eha_w.shape[0]
+    d = out[:n] - x_new[:n]
+    eha = jnp.real(jnp.sum(eha_w * jnp.conj(d) * d))
+    return new_state, out, rms, eha
 
 
 def schedule_res_tol(itsol, res_tol: float, dens_metric: float, nel: float,

@@ -5,6 +5,10 @@ V_H(G) = 4 pi rho(G) / G^2,  V_H(0) = 0 (jellium convention; the divergent
 G=0 pieces of Hartree/local/Ewald cancel in the total energy, tracked term
 by term exactly like the reference).
 E_H = Omega/2 sum_G |rho(G)|^2 4 pi / G^2.
+
+Both functions here are pure jnp and are traced directly inside the fused
+device-resident SCF step (dft/fused.py) as well as called from the host
+potential path — keep them free of host-side coercions.
 """
 
 from __future__ import annotations

@@ -13,12 +13,13 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from sirius_tpu.context import SimulationContext
 from sirius_tpu.core.fftgrid import g_to_r, r_to_g
-from sirius_tpu.dft.density import symmetrize_pw
+from sirius_tpu.dft.density import symmetrize_pw, symmetrize_pw_device
 from sirius_tpu.dft.poisson import hartree_potential_g
 from sirius_tpu.dft.xc import XCFunctional
 
@@ -225,3 +226,157 @@ def generate_potential(
         energies=energies,
         vtau_r_coarse=vtau_r_coarse,
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-resident potential generation (jit twin of generate_potential for
+# the fused SCF step, LDA/GGA; mGGA stays on the host fallback). All
+# transforms and the XC evaluation run as traced jnp ops so the whole
+# Poisson -> XC -> assembly chain compiles into the fused iteration; the
+# context tables arrive as a device-array dict so nothing host-resident is
+# captured in the compiled program.
+# ---------------------------------------------------------------------------
+
+
+def build_potential_device_tables(ctx: SimulationContext) -> dict:
+    """Constant context tables (numpy) for generate_potential_device."""
+    return {
+        "glen2": ctx.gvec.glen2,
+        "gcart": ctx.gvec.gcart,
+        "fft_index": ctx.gvec.fft_index,
+        "fft_index_coarse": ctx.gvec_coarse.fft_index,
+        "c2f": ctx.coarse_to_fine,
+        "vloc_re": np.real(ctx.vloc_g),
+        "vloc_im": np.imag(ctx.vloc_g),
+        "core_re": np.real(ctx.rho_core_g),
+        "core_im": np.imag(ctx.rho_core_g),
+    }
+
+
+def generate_potential_device(
+    xc: XCFunctional,
+    rho_g: jnp.ndarray,  # [ng] complex (inside the compiled program)
+    mag_g: jnp.ndarray | None,
+    tb: dict,
+    dims: tuple,
+    dims_coarse: tuple,
+    omega: float,
+    sym_tb: dict | None = None,
+) -> dict:
+    """Traced generate_potential: returns veff_g/bz_g/vha_g/vxc_g (complex,
+    program-internal), veff_r_coarse [ns, coarse box] real and the energy
+    integrals as traced scalars. sym_tb (density.build_sym_pw_tables)
+    enables the in-program PW symmetrization of veff/bz."""
+    if xc.is_mgga:
+        raise ValueError("device potential path does not support mGGA")
+    polarized = mag_g is not None
+    n = dims[0] * dims[1] * dims[2]
+    cdt = rho_g.dtype
+
+    def to_r(f_g):
+        return jnp.real(g_to_r(f_g, tb["fft_index"], tuple(dims)))
+
+    def to_g(f_r):
+        return r_to_g(f_r.astype(cdt), tb["fft_index"], tuple(dims))
+
+    def gradient_r(f_g):
+        return [to_r(1j * tb["gcart"][:, i] * f_g) for i in range(3)]
+
+    def divergence_g(vec_r):
+        return sum(
+            1j * tb["gcart"][:, i] * to_g(vec_r[i]) for i in range(3)
+        )
+
+    def inner_rr(f_r, g_r):
+        return jnp.sum(f_r * g_r) * (omega / n)
+
+    vloc_g = jax.lax.complex(tb["vloc_re"], tb["vloc_im"]).astype(cdt)
+    rho_core_g = jax.lax.complex(tb["core_re"], tb["core_im"]).astype(cdt)
+    vha_g = hartree_potential_g(rho_g, tb["glen2"])
+    rho_r = to_r(rho_g)
+    rho_core_r = to_r(rho_core_g)
+
+    if polarized:
+        mag_r = to_r(mag_g)
+        rho_xc = jnp.maximum(rho_r + rho_core_r, 1e-20)
+        m = jnp.clip(mag_r, -rho_xc, rho_xc)
+        n_up = 0.5 * (rho_xc + m)
+        n_dn = 0.5 * (rho_xc - m)
+        if xc.is_gga:
+            gu = gradient_r(0.5 * (rho_g + rho_core_g + mag_g))
+            gd = gradient_r(0.5 * (rho_g + rho_core_g - mag_g))
+            suu = sum(g * g for g in gu)
+            sdd = sum(g * g for g in gd)
+            sud = sum(a * b for a, b in zip(gu, gd))
+            out = xc.evaluate_polarized(
+                n_up.ravel(), n_dn.ravel(),
+                suu.ravel(), sud.ravel(), sdd.ravel(),
+            )
+            v_up = out["v_up"].reshape(dims)
+            v_dn = out["v_dn"].reshape(dims)
+            vsuu = out["vsigma_uu"].reshape(dims)
+            vsud = out["vsigma_ud"].reshape(dims)
+            vsdd = out["vsigma_dd"].reshape(dims)
+            v_up = v_up - to_r(divergence_g(
+                [2 * vsuu * a + vsud * b for a, b in zip(gu, gd)]))
+            v_dn = v_dn - to_r(divergence_g(
+                [2 * vsdd * b + vsud * a for a, b in zip(gu, gd)]))
+        else:
+            out = xc.evaluate_polarized(n_up.ravel(), n_dn.ravel())
+            v_up = out["v_up"].reshape(dims)
+            v_dn = out["v_dn"].reshape(dims)
+        e_r = out["e"].reshape(dims)
+        vxc_r = 0.5 * (v_up + v_dn)
+        bz_r = 0.5 * (v_up - v_dn)
+    else:
+        rho_xc = jnp.maximum(rho_r + rho_core_r, 0.0)
+        if xc.is_gga:
+            g = gradient_r(rho_g + rho_core_g)
+            sigma = g[0] ** 2 + g[1] ** 2 + g[2] ** 2
+            out = xc.evaluate(rho_xc.ravel(), sigma.ravel())
+            vxc_r = out["v"].reshape(dims)
+            vs = out["vsigma"].reshape(dims)
+            vxc_r = vxc_r - to_r(divergence_g([2.0 * vs * gi for gi in g]))
+        else:
+            out = xc.evaluate(rho_xc.ravel())
+            vxc_r = out["v"].reshape(dims)
+        e_r = out["e"].reshape(dims)
+        bz_r = None
+
+    exc_r = e_r / jnp.maximum(rho_xc, 1e-25)
+
+    vxc_g = to_g(vxc_r)
+    veff_g = vloc_g + vha_g + vxc_g
+    bz_g = to_g(bz_r) if polarized else None
+    if sym_tb is not None:
+        veff_g = symmetrize_pw_device(veff_g, sym_tb)
+        if bz_g is not None:
+            bz_g = symmetrize_pw_device(bz_g, sym_tb, axial_z=True)
+
+    def to_coarse(f_g):
+        return jnp.real(g_to_r(
+            f_g[tb["c2f"]], tb["fft_index_coarse"], tuple(dims_coarse)))
+
+    if polarized:
+        v_r = to_coarse(veff_g)
+        b_r = to_coarse(bz_g)
+        veff_r_coarse = jnp.stack([v_r + b_r, v_r - b_r])
+    else:
+        veff_r_coarse = to_coarse(veff_g)[None]
+
+    energies = {
+        "vha": inner_rr(rho_r, to_r(vha_g)),
+        "vxc": inner_rr(rho_r, vxc_r),
+        "vloc": inner_rr(rho_r, to_r(vloc_g)),
+        "veff": inner_rr(rho_r, to_r(veff_g)),
+        "exc": inner_rr(rho_r + rho_core_r, exc_r),
+        "bxc": inner_rr(mag_r, to_r(bz_g)) if polarized else jnp.zeros(()),
+    }
+    return {
+        "veff_g": veff_g,
+        "bz_g": bz_g,
+        "veff_r_coarse": veff_r_coarse,
+        "vha_g": vha_g,
+        "vxc_g": vxc_g,
+        "energies": energies,
+    }

@@ -501,6 +501,26 @@ def run_scf(
     if gsh_want:
         gsh = _setup_gshard(wf_dtype)
         scf_mesh = None  # the "g" mesh replaces the (k, b) mesh
+    # ---- chunked beta projectors (ops/beta_chunked.py): the dense
+    # [nbeta_total, ngk] table is never materialized — each atom chunk is
+    # regenerated inside the H application. Auto-dispatch mirrors gshard:
+    # engage when the dense table would exceed beta_chunk_budget_bytes
+    # (control.beta_chunked "auto"), or always when forced. Single-k
+    # unpolarized no-U regime, like gshard. ----
+    bchunk = None
+    bc_flag = cfg.control.beta_chunked
+    if (
+        not serial_bands and gsh is None
+        and bc_flag not in (False, "false", "off")
+        and nk == 1 and ns == 1 and hub is None and paw is None
+        and not mgga and ctx.beta.num_beta_total
+    ):
+        bc_foot = ctx.beta.num_beta_total * ctx.gkvec.ngk_max * 16
+        if bc_flag in (True, "force") or (
+            bc_flag == "auto"
+            and bc_foot > cfg.control.beta_chunk_budget_bytes
+        ):
+            bchunk = {"params": None, "dtype": None}
     # Gamma-point real-storage band solve (ops/gamma.py; reference
     # reduce_gvec, wave_functions.hpp:1589-1626): packed-real vectors make
     # the solver's GEMMs/eigh real. Hubbard needs the complex per-k U
@@ -509,6 +529,7 @@ def run_scf(
         cfg.control.reduce_gvec
         and not serial_bands
         and gsh is None
+        and bchunk is None
         and nk == 1
         and float(np.abs(np.asarray(ctx.gkvec.kpoints[0])).max()) < 1e-12
         and hub is None
@@ -537,22 +558,84 @@ def run_scf(
     # sit just above density_tol and stall tight decks at num_dft_iter
     res_tol = itsol.residual_tolerance
 
+    # ---- fused device-resident iteration (dft/fused.py): density ->
+    # mixer -> potential -> D/H-diag refresh as ONE compiled program with a
+    # donated carry; per-iteration host traffic is a [NUM_SCALARS] vector.
+    # control.device_scf = false keeps the host path below as the debug
+    # fallback (tests/test_fused_scf.py pins the two to ~1e-8 Ha). ----
+    fused = None
+    fused_carry = fused_out = fused_np = None
+    if (
+        cfg.control.device_scf not in (False, "false", "off")
+        and not serial_bands and gsh is None and not gamma_bands
+        and bchunk is None and hub is None and paw is None and not mgga
+        and mixer.kind in ("linear", "anderson")
+        and not _cks.enabled()
+    ):
+        from sirius_tpu.dft.fused import (
+            FusedScf,
+            S_BXC, S_E1, S_E2, S_EHA, S_ENT, S_EVAL, S_EXC, S_MAG, S_NEL,
+            S_RMS, S_V0, S_VHA, S_VXC,
+        )
+
+        if scf_mesh is not None:
+            # replicate the fused constants/state on the production mesh
+            # ONCE: jit against mesh-sharded band-solve outputs would
+            # otherwise reshard every uncommitted operand each iteration —
+            # a hidden per-iteration transfer (caught by the
+            # transfer-guard test in tests/test_fused_scf.py)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            _rep = NamedSharding(scf_mesh, PartitionSpec())
+
+            def _repl(t):
+                return jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, _rep), t
+                )
+        else:
+
+            def _repl(t):
+                return t
+
+        if beta_dev is not None:
+            beta_dev = _repl(beta_dev)
+        fused = FusedScf(ctx, xc, mixer, polarized, do_symmetrize,
+                         beta_dev=beta_dev)
+        fused.tables = _repl(fused.tables)
+        fused.kweights_dev = _repl(fused.kweights_dev)
+        fused_carry = _repl(fused.init_carry(x_mix, pot))
+        # pre-wrapped device scalars: python floats fed to jit are implicit
+        # host->device transfers, which the fused loop must not make
+        fused_nel = _repl(jnp.asarray(float(nel), dtype=jnp.float64))
+        fused_width = _repl(
+            jnp.asarray(float(p.smearing_width), dtype=jnp.float64)
+        )
+        fused_occmax = _repl(jnp.asarray(
+            float(ctx.max_occupancy), dtype=jnp.float64
+        ))
+        fused_dm0 = _repl(
+            (jnp.zeros((ns, 0, 0)), jnp.zeros((ns, 0, 0)))
+        )
+
     for it in range(p.num_dft_iter):
         # --- band solve per (k, spin) (warm start) ---
-        d_by_spin = []
-        for ispn in range(ns):
-            if ctx.aug is not None:
-                vs_g = pot.veff_g + (pot.bz_g if ispn == 0 else -pot.bz_g) if polarized else pot.veff_g
-                d_by_spin.append(
-                    d_operator(ctx.unit_cell, ctx.gvec, ctx.aug, vs_g, ctx.beta)
-                )
-            else:
-                d_by_spin.append(ctx.beta.dion)
-        if paw is not None:
-            # add the on-site PAW Dij (from the mixed on-site density) to
-            # the screened D before the band solve
-            d_by_spin = paw_mod.add_dij_to_d(paw, paw_res["dij_atoms"], d_by_spin)
-        v0 = float(np.real(pot.veff_g[0]))
+        if fused is None or fused_out is None:
+            # host D/v0 from the host potential; once the fused step has
+            # run, the refreshed D and v0 live on device (fused_out)
+            d_by_spin = []
+            for ispn in range(ns):
+                if ctx.aug is not None:
+                    vs_g = pot.veff_g + (pot.bz_g if ispn == 0 else -pot.bz_g) if polarized else pot.veff_g
+                    d_by_spin.append(
+                        d_operator(ctx.unit_cell, ctx.gvec, ctx.aug, vs_g, ctx.beta)
+                    )
+                else:
+                    d_by_spin.append(ctx.beta.dion)
+            if paw is not None:
+                # add the on-site PAW Dij (from the mixed on-site density) to
+                # the screened D before the band solve
+                d_by_spin = paw_mod.add_dij_to_d(paw, paw_res["dij_atoms"], d_by_spin)
+            v0 = float(np.real(pot.veff_g[0]))
         with profile("scf::band_solve"):
             if gsh is not None:
                 from sirius_tpu.ops.hamiltonian import real_dtype_of
@@ -620,6 +703,62 @@ def run_scf(
                         np.asarray(x), gsh["order"], ctx.gkvec.ngk_max
                     )
                 )[None, None]
+            elif bchunk is not None:
+                # chunk-generated projectors: the H/S application rebuilds
+                # each atom chunk's beta block on the fly (lax.scan), so the
+                # dense [nbeta, ngk] table never exists on device
+                from sirius_tpu.ops.beta_chunked import (
+                    apply_h_s_chunked,
+                    make_chunked_hk,
+                    pack_dmat_chunks,
+                )
+                from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+                rdt = real_dtype_of(wf_dtype)
+                if bchunk["dtype"] != wf_dtype:
+                    bchunk["params"] = make_chunked_hk(
+                        ctx, 0, dtype=wf_dtype,
+                        chunk=cfg.control.beta_chunk_size,
+                    )
+                    bchunk["dtype"] = wf_dtype
+                prm = dict(
+                    bchunk["params"],
+                    veff_r=jnp.asarray(pot.veff_r_coarse[0], dtype=rdt),
+                    dmat=jnp.asarray(
+                        pack_dmat_chunks(
+                            ctx, np.real(np.asarray(d_by_spin[0])),
+                            cfg.control.beta_chunk_size,
+                        ),
+                        dtype=rdt,
+                    ),
+                )
+                if psi is None and psi_big is not None:
+                    # one-off LCAO subspace init through the chunked apply
+                    xb = psi_big[0, 0] * np.asarray(ctx.gkvec.mask[0])
+                    hx, sx = apply_h_s_chunked(
+                        prm, jnp.asarray(xb, dtype=wf_dtype)
+                    )
+                    psi = np.zeros(
+                        (1, 1, nb, ctx.gkvec.ngk_max), dtype=np.complex128
+                    )
+                    psi[0, 0] = _subspace_rotate_host(
+                        xb, np.asarray(hx, dtype=np.complex128),
+                        np.asarray(sx, dtype=np.complex128), nb,
+                    )
+                    counters["num_loc_op_applied"] += psi_big.shape[2]
+                    psi_big = None
+                h_diag, o_diag = _h_o_diag(ctx, 0, v0, d_by_spin[0])
+                ev, x, rn = davidson(
+                    apply_h_s_chunked, prm,
+                    jnp.asarray(np.asarray(psi[0, 0]), dtype=wf_dtype),
+                    jnp.asarray(h_diag, dtype=rdt),
+                    jnp.asarray(o_diag, dtype=rdt),
+                    jnp.asarray(ctx.gkvec.mask[0], dtype=rdt),
+                    num_steps=itsol.num_steps,
+                    res_tol=res_tol,
+                )
+                evals[0, 0] = np.asarray(ev)
+                psi = np.asarray(x).astype(np.complex128)[None, None]
             elif gamma_bands:
                 from sirius_tpu.ops.gamma import (
                     davidson_gamma,
@@ -750,12 +889,34 @@ def run_scf(
                     split_cplx,
                 )
 
-                ps = kset_params(
-                    pot.veff_r_coarse[:ns], np.stack(d_by_spin), v0, vhub,
-                    wf_dtype,
-                )
-                ps = place_kset_params(ps, scf_mesh)
                 rdt = real_dtype_of(wf_dtype)
+                if (
+                    fused is not None and fused_out is not None
+                    and wf_dtype in _kset_cache
+                ):
+                    # device-resident refresh: the fused step already
+                    # produced veff_r/D/h_diag on device — swap them into
+                    # the cached params without any host round-trip
+                    _kset_cache[wf_dtype] = _kset_cache[wf_dtype]._replace(
+                        veff_r=fused_out["veff_r_coarse"].astype(rdt),
+                        dion=fused_out["dion"].astype(rdt),
+                        h_diag=fused_out["h_diag"].astype(rdt),
+                    )
+                    ps = _kset_cache[wf_dtype]
+                elif fused is not None and fused_out is not None:
+                    # precision switch (fp32 -> fp64 polish): one-time host
+                    # fetch to build the new-precision constant tables
+                    ps = kset_params(
+                        np.asarray(fused_out["veff_r_coarse"]),
+                        np.asarray(fused_out["dion"]),
+                        float(fused_np[S_V0]), vhub, wf_dtype,
+                    )
+                else:
+                    ps = kset_params(
+                        pot.veff_r_coarse[:ns], np.stack(d_by_spin), v0,
+                        vhub, wf_dtype,
+                    )
+                ps = place_kset_params(ps, scf_mesh)
                 if pr is None and psi is None and psi_big is not None:
                     # first iteration from a fresh LCAO block: rotate the
                     # full atomic-orbital subspace down to the lowest nb
@@ -808,7 +969,12 @@ def run_scf(
                 # consumers that need it (Hubbard occupations each
                 # iteration, forces/stress/checkpoint after the loop)
                 psi = join_cplx(pr, pi) if hub is not None else None
-                evals = np.asarray(ev, dtype=np.float64)
+                if fused is not None:
+                    # eigenvalues stay on device; the host copy is fetched
+                    # once after the loop for the final report
+                    ev_dev = ev.astype(jnp.float64)
+                else:
+                    evals = np.asarray(ev, dtype=np.float64)
             # H*psi application count (reference num_loc_op_applied counter)
             from sirius_tpu.solvers.davidson import num_applies
 
@@ -817,6 +983,90 @@ def run_scf(
             )
         if _cks.enabled():
             _cks.checksum("evals", evals)
+
+        if fused is not None:
+            # --- fused device-resident remainder of the iteration: fermi
+            # search, density, mixing, potential and the D/h_diag refresh
+            # all run on device; ONE scalar vector comes back ---
+            with profile("scf::fused_step"):
+                mu, occ, entropy_sum = find_fermi(
+                    ev_dev, fused.kweights_dev, fused_nel, fused_width,
+                    kind=p.smearing, max_occupancy=fused_occmax,
+                )
+                occ_w = occ * fused.kweights_dev[:, None, None]
+                from sirius_tpu.parallel.batched import (
+                    density_kset,
+                    density_matrix_kset,
+                )
+
+                acc = density_kset(ps, pr, pi, occ_w)
+                if fused.has_aug and beta_dev is not None:
+                    dm_re, dm_im = density_matrix_kset(
+                        *beta_dev, pr, pi, occ_w
+                    )
+                else:
+                    dm_re, dm_im = fused_dm0
+                fused_carry, fused_out = fused.step(
+                    fused_carry, acc, dm_re, dm_im, ev_dev, occ_w,
+                    entropy_sum,
+                )
+            # the ONLY per-iteration device->host fetch
+            fused_np = np.asarray(fused_out["scalars"])
+            if not np.all(np.isfinite(fused_np)):
+                raise FloatingPointError(
+                    f"SCF diverged at iteration {it + 1}: non-finite "
+                    "scalars from the device-resident step (try smaller "
+                    "mixer.beta, or control.device_scf = false to debug "
+                    "on the host path)"
+                )
+            rms = float(fused_np[S_RMS])
+            eha_res = float(fused_np[S_EHA])
+            dens_metric = eha_res if mixer.use_hartree else rms
+            res_tol = schedule_res_tol(itsol, res_tol, dens_metric, nel,
+                                       mixer.use_hartree)
+            scf_correction = (
+                float(fused_np[S_E2] - fused_np[S_E1])
+                if p.use_scf_correction else 0.0
+            )
+            e_total = (
+                float(fused_np[S_EVAL] - fused_np[S_VXC] - fused_np[S_BXC]
+                      - 0.5 * fused_np[S_VHA] + fused_np[S_EXC])
+                + ctx.e_ewald + scf_correction
+            )
+            if cfg.control.verification >= 1:
+                nel_got = float(fused_np[S_NEL])
+                if abs(nel_got - nel) > 1e-6 * max(1.0, nel):
+                    import warnings
+
+                    warnings.warn(
+                        f"electron count from density {nel_got:.8f} != "
+                        f"{nel:.8f}"
+                    )
+            etot_history.append(e_total + float(fused_np[S_ENT]))
+            rms_history.append(rms)
+            if polarized:
+                mag_history.append(float(fused_np[S_MAG]))
+            num_iter_done = it + 1
+            if cfg.control.verbosity >= 2:
+                mg = f" mag={mag_history[-1]:+.4f}" if polarized else ""
+                print(
+                    f"[scf] it={it + 1:3d} etot={e_total:+.10f} "
+                    f"rms={rms:.3e}{mg}",
+                    flush=True,
+                )
+            de = abs(e_total - e_prev) if e_prev is not None else np.inf
+            e_prev = e_total
+            if (
+                wf_dtype == jnp.complex64
+                and cfg.settings.fp32_to_fp64_rms > 0
+                and rms < cfg.settings.fp32_to_fp64_rms
+            ):
+                wf_dtype = jnp.complex128
+                continue
+            if de < p.energy_tol and dens_metric < p.density_tol:
+                converged = True
+                break
+            continue
 
         # --- occupations ---
         mu, occ, entropy_sum = find_fermi(
@@ -872,7 +1122,8 @@ def run_scf(
         # --- density (per spin, then charge/magnetization assembly) ---
         occ_w = jnp.asarray(occ_np * ctx.kweights[:, None, None])
         with profile("scf::density"):
-            if serial_bands or gamma_bands or gsh is not None:
+            if (serial_bands or gamma_bands or gsh is not None
+                    or bchunk is not None):
                 rho_spin = generate_density_g(ctx, psi, occ_np)
             else:
                 from sirius_tpu.dft.density import density_from_coarse_acc
@@ -1082,6 +1333,20 @@ def run_scf(
             break
 
     # --- final report ---
+    if fused is not None and fused_out is not None:
+        # one-time exit fetch from the device-resident loop: mixed density,
+        # D matrices and dm blocks for forces/stress, plus a host-side
+        # potential regeneration so the report/checkpoint path below sees
+        # the same PotentialResult fields it always has
+        evals = np.asarray(ev_dev, dtype=np.float64)
+        fin = fused.finalize(fused_carry, fused_out)
+        rho_g = fin["rho_g"]
+        mag_g = fin["mag_g"]
+        d_by_spin = fin["d_by_spin"]
+        rho_resid_g = fin["rho_resid_g"]
+        dm_blocks_by_spin = fin["dm_blocks_by_spin"]
+        with profile("scf::potential"):
+            pot = generate_potential(ctx, rho_g, xc, mag_g)
     if psi is None and pr is not None:
         from sirius_tpu.parallel.batched import join_cplx
 

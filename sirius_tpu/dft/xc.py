@@ -13,6 +13,11 @@ end-to-end for forces/stress later.
 
 Hartree atomic units throughout. sigma = |grad n|^2 contractions, libxc
 convention.
+
+evaluate()/evaluate_polarized() are traced inside the fused device-resident
+SCF step (dft/fused.py) in addition to the host path: they must stay pure
+jnp on traced inputs — no numpy coercion, python branching on data, or host
+callbacks.
 """
 
 from __future__ import annotations

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from sirius_tpu.core.gvec import Gvec
@@ -197,3 +199,84 @@ def d_operator(
         block[at.xi2, at.xi1] = v
         d[off : off + nbf, off : off + nbf] += block
     return d
+
+
+# ---------------------------------------------------------------------------
+# Device-resident augmentation (jit twins of rho_aug_g / d_operator for the
+# fused SCF step). The ragged per-type structure is pre-flattened into
+# dense tables once; the per-iteration contractions become pure einsums and
+# flat-index scatters over the full [nbeta, nbeta] D matrix.
+# ---------------------------------------------------------------------------
+
+
+def build_aug_device_tables(uc: UnitCell, gvec: Gvec, aug: Augmentation,
+                            beta) -> list[dict]:
+    """Per-type numpy tables for rho_aug_g_device / d_operator_device.
+
+    gidx flattens the (off + xi1, off + xi2) positions of each atom's
+    packed pairs into the [nbeta * nbeta] D matrix (the upper/packed site);
+    lo_idx is the mirrored (off + xi2, off + xi1) site with lo_mask zeroing
+    the diagonal pairs — together they reproduce the host d_operator's
+    symmetric block fill without double-counting xi1 == xi2."""
+    nbeta = beta.num_beta_total
+    offs = {ia: off for ia, off, _ in beta.atom_blocks(uc)}
+    out = []
+    for it, at in enumerate(aug.per_type):
+        if at is None:
+            continue
+        atoms = uc.atoms_of_type(it)
+        phases = np.exp(-2j * np.pi * (gvec.millers @ uc.positions[atoms].T))
+        gidx = np.stack([
+            (offs[ia] + at.xi1).astype(np.int64) * nbeta + (offs[ia] + at.xi2)
+            for ia in atoms
+        ]).astype(np.int32)  # (na_t, nqlm)
+        lo_idx = np.stack([
+            (offs[ia] + at.xi2).astype(np.int64) * nbeta + (offs[ia] + at.xi1)
+            for ia in atoms
+        ]).astype(np.int32)
+        out.append({
+            "q_re": np.real(at.q_pw),
+            "q_im": np.imag(at.q_pw),
+            "ph_re": np.real(phases),
+            "ph_im": np.imag(phases),
+            "w": np.where(at.xi1 == at.xi2, 1.0, 2.0),
+            "gidx": gidx,
+            "lo_idx": lo_idx,
+            "lo_mask": (at.xi1 != at.xi2).astype(np.float64),
+        })
+    return out
+
+
+def rho_aug_g_device(dm: jnp.ndarray, tables: list[dict],
+                     ng: int) -> jnp.ndarray:
+    """Jit-safe rho_aug_g over all spin channels at once: dm complex
+    [ns, nbeta, nbeta] (full matrix, inside the compiled program), returns
+    [ns, ng] complex."""
+    ns = dm.shape[0]
+    dm_flat = dm.reshape(ns, -1)
+    out = jnp.zeros((ns, ng), dtype=dm.dtype)
+    for t in tables:
+        q = jax.lax.complex(t["q_re"], t["q_im"])
+        ph = jax.lax.complex(t["ph_re"], t["ph_im"])
+        dmp = t["w"][None, None, :] * jnp.real(dm_flat[:, t["gidx"]])
+        out = out + jnp.einsum("ga,saq,qg->sg", ph, dmp.astype(q.dtype), q)
+    return out
+
+
+def d_operator_device(veff_g: jnp.ndarray, dion: jnp.ndarray,
+                      tables: list[dict], omega: float) -> jnp.ndarray:
+    """Jit-safe d_operator for one effective-potential channel: veff_g
+    complex [ng], dion real [nbeta, nbeta] bare matrix; returns the full
+    real D [nbeta, nbeta]."""
+    nbeta = dion.shape[0]
+    d = dion.reshape(-1)
+    for t in tables:
+        q = jax.lax.complex(t["q_re"], t["q_im"])
+        ph = jax.lax.complex(t["ph_re"], t["ph_im"])
+        vq = omega * jnp.real(
+            jnp.einsum("qg,g,ga->aq", q, jnp.conj(veff_g), ph))  # (na, nqlm)
+        vq = vq.astype(d.dtype)
+        d = d.at[t["gidx"].reshape(-1)].add(vq.reshape(-1))
+        d = d.at[t["lo_idx"].reshape(-1)].add(
+            (vq * t["lo_mask"][None, :]).reshape(-1))
+    return d.reshape(nbeta, nbeta)

@@ -65,7 +65,10 @@ def build_tables(ctx, ik: int, d_full: np.ndarray | None = None,
     # dense radial tables over every species' beta radial functions
     from sirius_tpu.ops.beta import beta_radial_table
 
-    NQ = max(2048, int(qmax * 192))
+    # dense enough that the linear interpolation error (~dq^2 f'') sits
+    # below the SCF equality bar: the full chunked band solve must agree
+    # with the dense-table path to ~1e-8 Ha (tests/test_beta_chunked.py)
+    NQ = max(8192, int(qmax * 768))
     qs = np.linspace(0.0, qmax, NQ)
     ri_rows = []
     rf_off_type = []
@@ -185,3 +188,126 @@ def chunked_nonlocal(tb: BetaChunkTables, psi: jax.Array, mask=None,
     )
     (h, s), _ = jax.lax.scan(step, (z, z), chunks)
     return h, s
+
+
+# ---------------------------------------------------------------------------
+# SCF integration: the full (local + chunked non-local) H/S application as a
+# davidson-compatible module-level function over a dict pytree. run_scf
+# selects this path when the dense projector table would blow the footprint
+# budget (control.beta_chunked, same auto-dispatch pattern as gshard).
+# ---------------------------------------------------------------------------
+
+
+def pack_dmat_chunks(ctx, d_full: np.ndarray, chunk: int = 16) -> np.ndarray:
+    """Per-atom diagonal blocks of a screened [nbeta, nbeta] D matrix packed
+    into the fixed [n_steps, chunk, nxi_max, nxi_max] scan layout (the same
+    fill build_tables applies to its dmat)."""
+    uc = ctx.unit_cell
+    nat = uc.num_atoms
+    nxi_max = max(
+        (sum(2 * b.l + 1 for b in uc.atom_types[uc.type_of_atom[ia]].beta)
+         for ia in range(nat)),
+        default=1,
+    )
+    n_steps = (nat + chunk - 1) // chunk
+    out = np.zeros((n_steps, chunk, nxi_max, nxi_max))
+    for ia, off, nbf in ctx.beta.atom_blocks(uc):
+        s, c = divmod(ia, chunk)
+        out[s, c, :nbf, :nbf] = np.real(
+            d_full[off : off + nbf, off : off + nbf]
+        )
+    return out
+
+
+def make_chunked_hk(ctx, ik: int, dtype=jnp.complex128,
+                    chunk: int = 16) -> dict:
+    """Constant device tables for apply_h_s_chunked as a dict pytree of
+    REAL leaves (the complex (-i)^l prefactors ride as a (re, im) pair —
+    jit-boundary contract of parallel/batched.py). veff_r and dmat are
+    placeholders the SCF loop swaps per iteration via dict(prm, ...)."""
+    from sirius_tpu.ops.hamiltonian import real_dtype_of
+
+    tb = build_tables(ctx, ik, chunk=chunk)
+    rdt = real_dtype_of(dtype)
+    return {
+        "ekin": jnp.asarray(ctx.gkvec.kinetic()[ik], dtype=rdt),
+        "mask": jnp.asarray(ctx.gkvec.mask[ik], dtype=rdt),
+        "fft_index": jnp.asarray(ctx.gkvec.fft_index[ik]),
+        "veff_r": jnp.zeros(tuple(ctx.fft_coarse.dims), dtype=rdt),
+        "dmat": jnp.asarray(tb.dmat, dtype=rdt),
+        "qmat_c": jnp.asarray(tb.qmat, dtype=rdt),
+        "pos": jnp.asarray(tb.pos, dtype=rdt),
+        "xi_rf": jnp.asarray(tb.xi_rf),
+        "xi_lm": jnp.asarray(tb.xi_lm),
+        "cph_re": jnp.asarray(np.real(tb.xi_cph), dtype=rdt),
+        "cph_im": jnp.asarray(np.imag(tb.xi_cph), dtype=rdt),
+        "rlm": jnp.asarray(tb.rlm, dtype=rdt),
+        "q": jnp.asarray(tb.q, dtype=rdt),
+        "mk": jnp.asarray(tb.mk, dtype=rdt),
+        "ri_grid": jnp.asarray(tb.ri_grid, dtype=rdt),
+        "dq": jnp.asarray(tb.dq, dtype=rdt),
+        "pref": jnp.asarray(tb.pref, dtype=rdt),
+    }
+
+
+def apply_h_s_chunked(prm: dict, psi: jax.Array):
+    """(H psi, S psi) with on-the-fly chunked projectors: the local part of
+    ops.hamiltonian.apply_h_s plus chunked_nonlocal's scan, reading from a
+    dict pytree so the davidson jit compiles once per deck."""
+    dims = prm["veff_r"].shape
+    n = dims[0] * dims[1] * dims[2]
+    mask = prm["mask"]
+    psi = psi * mask
+    batch = psi.shape[:-1]
+    box = jnp.zeros(batch + (n,), dtype=psi.dtype).at[
+        ..., prm["fft_index"]
+    ].add(psi)
+    fr = jnp.fft.ifftn(box.reshape(batch + dims), axes=(-3, -2, -1))
+    vpsi = (
+        jnp.fft.fftn(fr * prm["veff_r"], axes=(-3, -2, -1))
+        .reshape(batch + (n,))[..., prm["fft_index"]]
+    )
+    ekin = jnp.where(mask > 0, prm["ekin"], 0.0)
+    hpsi = ekin * psi + vpsi
+    spsi = psi
+
+    dtype = psi.dtype
+    rdt = prm["q"].dtype
+    # interpolate every DISTINCT radial function once, outside the scan,
+    # mask baked in so generated projectors ignore padded G slots (exactly
+    # like the dense table of beta.py BetaProjectors.build)
+    iq = jnp.clip(prm["q"] / prm["dq"], 0.0, prm["ri_grid"].shape[1] - 1.001)
+    i0 = iq.astype(jnp.int32)
+    t = (iq - i0).astype(rdt)
+    ri_all = (
+        prm["ri_grid"][:, i0] * (1.0 - t) + prm["ri_grid"][:, i0 + 1] * t
+    ) * mask
+    cph = jax.lax.complex(prm["cph_re"], prm["cph_im"]).astype(dtype)
+
+    def step(carry, chunk):
+        hacc, sacc = carry
+        pos_c, rf_c, lm_c, cph_c, d_c, q_c = chunk
+        ri = ri_all[rf_c]  # [C, nxi, ngk]
+        ang = prm["rlm"][:, lm_c]  # [ngk, C, nxi]
+        phase = jnp.exp(
+            (-2j * jnp.pi) * (prm["mk"] @ pos_c.T).astype(rdt)
+        ).astype(dtype)  # [ngk, C]
+        beta_c = (
+            prm["pref"]
+            * cph_c[:, :, None]
+            * jnp.transpose(ang, (1, 2, 0)).astype(dtype)
+            * ri.astype(dtype)
+            * jnp.transpose(phase)[:, None, :]
+        )  # [C, nxi, ngk]
+        bp = jnp.einsum("cxg,bg->bcx", jnp.conj(beta_c), psi)
+        hacc = hacc + jnp.einsum("bcx,cxy,cyg->bg", bp, d_c, beta_c)
+        sacc = sacc + jnp.einsum("bcx,cxy,cyg->bg", bp, q_c, beta_c)
+        return (hacc, sacc), None
+
+    z = jnp.zeros(psi.shape, dtype)
+    (hnl, snl), _ = jax.lax.scan(
+        step, (z, z),
+        (prm["pos"], prm["xi_rf"], prm["xi_lm"], cph, prm["dmat"],
+         prm["qmat_c"]),
+    )
+    return (hpsi + hnl) * mask, (spsi + snl) * mask

@@ -99,6 +99,22 @@ def compute_h_diag(ctx, dion, v0: float = 0.0):
     return h_diag
 
 
+def compute_h_diag_device(ekin, mask, beta_re, beta_im, dion, v0):
+    """Traced twin of compute_h_diag for the fused device-resident SCF
+    step: all inputs are arrays already on device (ekin/mask [nk, ngk],
+    beta pair [nk, nbeta, ngk], dion [ns, nbeta, nbeta], v0 traced scalar).
+    Returns [nk, ns, ngk]. Call only inside a compiled program."""
+    h = ekin[:, None, :] + v0
+    if beta_re.shape[1]:
+        b = _cplx(beta_re, beta_im)
+        h = h + jnp.real(
+            jnp.einsum("kxg,sxy,kyg->ksg", jnp.conj(b), dion, b)
+        )
+    else:
+        h = jnp.broadcast_to(h, (h.shape[0], dion.shape[0], h.shape[2]))
+    return jnp.where(mask[:, None, :] > 0, h, 1e4)
+
+
 def compute_o_diag(ctx):
     """o_diag [nk, ngk]: S preconditioner diagonal; potential-independent
     (only the constant augmentation Q enters), computed once per run."""

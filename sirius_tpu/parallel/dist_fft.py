@@ -30,6 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.5 ships shard_map under jax.experimental only
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def x_slab_spec() -> P:
     """Spec of a [..., n1, n2, n3] box sharded into x-slabs over "g"."""
@@ -94,14 +99,14 @@ def make_dist_fft(mesh: Mesh, dims: tuple[int, int, int], batch: int):
     ys = NamedSharding(mesh, y_slab_spec())
 
     fwd = jax.jit(
-        jax.shard_map(
+        _shard_map(
             partial(fft3d_shard, axis_name="g"),
             mesh=mesh, in_specs=x_slab_spec(), out_specs=y_slab_spec(),
         ),
         in_shardings=xs, out_shardings=ys,
     )
     inv = jax.jit(
-        jax.shard_map(
+        _shard_map(
             partial(ifft3d_shard, axis_name="g"),
             mesh=mesh, in_specs=y_slab_spec(), out_specs=x_slab_spec(),
         ),
@@ -133,7 +138,7 @@ def make_apply_veff_dist(mesh: Mesh, dims: tuple[int, int, int]):
         return fft3d_shard(r, "g")
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             _core, mesh=mesh,
             in_specs=(y_slab_spec(), P("g", None, None)),
             out_specs=y_slab_spec(),
@@ -253,7 +258,7 @@ def _gshard_inner(mesh: Mesh, n1p: int, n2: int, n3: int):
         return hpsi * mask_loc, spsi * mask_loc
 
     inner = jax.jit(
-        jax.shard_map(
+        _shard_map(
             _apply, mesh=mesh,
             in_specs=(gspec, gspec1, gspec1, P(None, "g"), gspec1, P(), P(),
                       P(None, "g", None)),

@@ -19,7 +19,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Force CPU: the suite needs f64/c128 (unsupported on TPU) and a virtual
 # multi-device mesh. Set SIRIUS_TPU_TEST_PLATFORM to override.
 jax.config.update("jax_platforms", os.environ.get("SIRIUS_TPU_TEST_PLATFORM", "cpu"))
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; XLA_FLAGS is still
+    # honored because the CPU backend has not been initialized yet
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_enable_x64", True)
 
 REFERENCE_ROOT = "/root/reference"

@@ -1,0 +1,164 @@
+"""Fused device-resident SCF iteration (dft/fused.py): the jitted
+density -> potential -> mixer pipeline must reproduce the host debug path
+(control.device_scf = false) to near machine precision, and must not move
+anything bigger than the scalar record across the host boundary per
+iteration."""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sirius_tpu.config.schema import MixerConfig
+from sirius_tpu.dft.mixer import (
+    Mixer,
+    device_mix,
+    device_mixer_init,
+    device_mixer_weights,
+)
+from sirius_tpu.testing import synthetic_silicon_context
+
+
+def _run(device_scf, **deck):
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx = synthetic_silicon_context(**deck)
+    ctx.cfg.control.device_scf = device_scf
+    return run_scf(ctx.cfg, ctx=ctx)
+
+
+def test_fused_matches_host_ultrasoft():
+    """Unpolarized ultrasoft deck, no symmetry: fused vs host total energy."""
+    deck = dict(
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(2, 2, 2), num_bands=8,
+        ultrasoft=True, use_symmetry=False,
+        extra_params={"num_dft_iter": 25, "density_tol": 5e-9,
+                      "energy_tol": 1e-10},
+    )
+    r_host = _run("off", **deck)
+    r_dev = _run("auto", **deck)
+    assert r_host["converged"] and r_dev["converged"]
+    assert r_host["num_scf_iterations"] == r_dev["num_scf_iterations"]
+    assert abs(r_host["energy"]["total"] - r_dev["energy"]["total"]) < 1e-8
+
+
+@pytest.mark.slow
+def test_fused_matches_host_polarized_symmetry():
+    """Collinear-polarized deck with symmetrization (density-matrix +
+    plane-wave symmetrization run inside the fused program)."""
+    deck = dict(
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(2, 2, 2), num_bands=8,
+        ultrasoft=True, use_symmetry=True,
+        moments=[[0, 0, 0.5], [0, 0, -0.5]],
+        extra_params={"num_dft_iter": 30, "density_tol": 5e-9,
+                      "energy_tol": 1e-10, "num_mag_dims": 1},
+    )
+    r_host = _run("off", **deck)
+    r_dev = _run("auto", **deck)
+    assert r_host["converged"] and r_dev["converged"]
+    assert abs(r_host["energy"]["total"] - r_dev["energy"]["total"]) < 1e-8
+    assert abs(r_host["mag_history"][-1] - r_dev["mag_history"][-1]) < 1e-6
+
+
+def test_fused_no_host_transfers():
+    """Everything between the band solve and the scalar fetch — fermi
+    search, density accumulation, augmentation, mixing, potential, D/h_diag
+    refresh — must run without implicit host<->device transfers.
+
+    run_scf wraps exactly that region in profile("scf::fused_step"); hook
+    the profiler so the span also enters jax.transfer_guard("disallow"),
+    then run a small fused SCF: any per-iteration host round-trip inside
+    the span raises."""
+    import sirius_tpu.dft.scf as scf_mod
+    from sirius_tpu.utils import profiler
+
+    saw_span = []
+    orig_profile = profiler.profile
+
+    @contextlib.contextmanager
+    def guarded(name):
+        with orig_profile(name):
+            if name == "scf::fused_step":
+                saw_span.append(name)
+                with jax.transfer_guard("disallow"):
+                    yield
+            else:
+                yield
+
+    old = scf_mod.profile
+    scf_mod.profile = guarded
+    try:
+        res = _run(
+            "auto",
+            gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+            ultrasoft=True, use_symmetry=False,
+            extra_params={"num_dft_iter": 6, "density_tol": 1e-12,
+                          "energy_tol": 1e-14},
+        )
+    finally:
+        scf_mod.profile = old
+    assert saw_span, "fused device path did not engage on the test deck"
+    assert np.isfinite(res["energy"]["total"])
+
+
+def test_fused_respects_off_switch():
+    """control.device_scf = false must keep the host path (no fused span)."""
+    from sirius_tpu.utils.profiler import reset_timers, timer_report
+
+    reset_timers()
+    _run(
+        "off",
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+        ultrasoft=True, use_symmetry=False,
+        extra_params={"num_dft_iter": 3, "density_tol": 1e-12,
+                      "energy_tol": 1e-14},
+    )
+    assert not any("fused" in k for k in timer_report())
+
+
+def _host_mixer(kind, nx, ng, max_history, beta, use_hartree=False):
+    cfg = MixerConfig(type=kind, beta=beta, max_history=max_history,
+                      use_hartree=use_hartree)
+    rng = np.random.default_rng(7)
+    glen2 = np.concatenate([[0.0], rng.uniform(0.2, 9.0, ng - 1)])
+    ncomp = nx // ng
+    return Mixer(cfg, glen2=glen2, num_components=ncomp, omega=270.1)
+
+
+@pytest.mark.parametrize("kind", ["linear", "anderson"])
+@pytest.mark.parametrize("ncomp", [1, 2])
+def test_device_mixer_matches_host(kind, ncomp):
+    """device_mix is the jitted twin of Mixer: same trajectory, rms and
+    residual Hartree energy over a synthetic fixed-point iteration, with
+    the fixed-shape masked history matching the host's growing one."""
+    ng, mh, beta = 40, 4, 0.55
+    nx = ncomp * ng
+    host = _host_mixer(kind, nx, ng, mh, beta)
+    weights = device_mixer_weights(host)
+    state = device_mixer_init(nx, mh)
+
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(nx, nx)) / np.sqrt(nx) * 0.35
+    b = rng.normal(size=nx) + 1j * rng.normal(size=nx)
+    x_host = x_dev = rng.normal(size=nx) + 1j * rng.normal(size=nx)
+
+    step = jax.jit(device_mix, static_argnames=("beta", "kind", "max_history"))
+    for _ in range(9):  # runs past the history depth (roll branch)
+        new_host = a @ x_host + b
+        rms_h = host.rms(x_host, new_host)
+        x_host_m = host.mix(x_host, new_host)
+        eha_h = host.residual_hartree_energy(x_host_m, new_host)
+
+        new_dev = jnp.asarray(a @ x_dev + b)
+        state, x_dev_m, rms_d, eha_d = step(
+            state, jnp.asarray(x_dev), new_dev, weights,
+            beta=beta, kind=kind, max_history=mh,
+        )
+        np.testing.assert_allclose(np.asarray(x_dev_m), x_host_m,
+                                   rtol=1e-10, atol=1e-12)
+        np.testing.assert_allclose(float(rms_d), rms_h, rtol=1e-10)
+        np.testing.assert_allclose(float(eha_d), eha_h, rtol=1e-8,
+                                   atol=1e-14)
+        x_host, x_dev = x_host_m, np.asarray(x_dev_m)

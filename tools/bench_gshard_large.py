@@ -1,26 +1,32 @@
 """Record the flagship large-tier SCF through run_scf on an n-device "g"
-mesh (VERDICT r4 item 5: the G-sharded operator dispatched from run_scf at
-the Si-supercell scale, not a demo). Writes GSHARD_LARGE.json.
+mesh (VERDICT r4 item 5 / r5 item 10: the G-sharded operator dispatched from
+run_scf at the Si-supercell scale, not a demo). The parent sweeps
+n_devices in {1, 2, 4, 8} — each count in a fresh subprocess with
+XLA_FLAGS=--xla_force_host_platform_device_count=N (a virtual CPU mesh:
+scaling numbers measure sharding/collective overhead, not real chips) —
+and writes the combined sweep to GSHARD_LARGE.json.
 
-Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-       python tools/bench_gshard_large.py [ndev]
+Usage: python tools/bench_gshard_large.py            # full sweep
+       python tools/bench_gshard_large.py --child    # one count (env NDEV)
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+DEVICE_COUNTS = (1, 2, 4, 8)
+CHILD_TIMEOUT_S = int(os.environ.get("GSHARD_BENCH_CHILD_TIMEOUT_S", "1800"))
 
-def main() -> int:
-    import numpy as np
+
+def child() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
     from sirius_tpu.dft.scf import run_scf
     from sirius_tpu.testing import synthetic_silicon_context
@@ -37,25 +43,71 @@ def main() -> int:
     res = run_scf(ctx.cfg, ctx=ctx)
     wall = time.time() - t0
     niter = res["num_scf_iterations"]
-    out = {
-        "what": "run_scf large tier (Si-54atom US, 256 bands) with the "
-                "G-sharded slab-FFT band solve auto-dispatched over the "
-                "'g' mesh",
+    print(json.dumps({
         "ndev": ndev,
         "platform": jax.devices()[0].platform,
-        "host_ncpu": os.cpu_count(),
         "num_scf_iterations": niter,
         "wall_s_total": round(wall, 1),
         "s_per_iteration": round(wall / max(niter, 1), 2),
         "etot_first_iters": [round(float(x), 6) for x in res["etot_history"]],
         "ngk": int(ctx.gkvec.ngk_max),
         "nbeta_total": int(ctx.beta.num_beta_total),
+    }))
+    return 0
+
+
+def main() -> int:
+    runs = []
+    for ndev in DEVICE_COUNTS:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count", "--_replaced"
+            )
+            + f" --xla_force_host_platform_device_count={ndev}"
+        ).strip()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"ndev={ndev}: timed out after {CHILD_TIMEOUT_S}s\n")
+            runs.append({"ndev": ndev, "error": f"timeout {CHILD_TIMEOUT_S}s"})
+            continue
+        lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+        if r.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            runs.append(rec)
+            sys.stderr.write(
+                f"ndev={ndev}: {rec['s_per_iteration']} s/iter\n"
+            )
+        else:
+            sys.stderr.write(
+                f"ndev={ndev}: failed rc={r.returncode}\n{r.stderr[-500:]}\n"
+            )
+            runs.append({"ndev": ndev, "error": f"rc={r.returncode}"})
+    ok = [r for r in runs if "s_per_iteration" in r]
+    out = {
+        "what": "run_scf large tier (Si-54atom US, 256 bands, 10-step "
+                "Davidson) with the G-sharded slab-FFT band solve forced "
+                "over an n-device 'g' mesh; sweep over virtual CPU device "
+                "counts — measures sharding/collective overhead, not "
+                "real-chip speedup (single physical host)",
+        "host_ncpu": os.cpu_count(),
+        "runs": runs,
+        "scaling_s_per_iteration": {
+            str(r["ndev"]): r["s_per_iteration"] for r in ok
+        },
     }
     with open(os.path.join(REPO, "GSHARD_LARGE.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
-    return 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
+    if "--child" in sys.argv[1:]:
+        raise SystemExit(child())
     raise SystemExit(main())
