@@ -66,6 +66,42 @@ void set_err(int* error_code, int v)
     }
 }
 
+/* Guarded PyObject -> C conversions. The embedded interpreter can hand
+ * back malformed values (a monkeypatched bridge, a partially built
+ * context, an exception swallowed upstream); a bare PyLong_AsLong /
+ * PyFloat_AsDouble then either segfaults on NULL or leaks a pending
+ * exception into the caller's next embedded call. Every getter reports
+ * through *ok / error_code instead of crashing the host process. */
+
+static bool copy_str(PyObject* r, char* out, int out_len)
+{
+    /* tolerates r == NULL (missing dict key) — copies "" and reports
+     * false so callers that REQUIRE the field can flag the error */
+    const char* s = r ? PyUnicode_AsUTF8(r) : nullptr;
+    if (!s) PyErr_Clear();
+    std::snprintf(out, (size_t)out_len, "%s", s ? s : "");
+    return s != nullptr;
+}
+
+/* PyLong_AsLong with NULL/err tolerance: missing or non-int dict items
+ * report through *ok instead of segfaulting the host process */
+static long as_long_checked(PyObject* o, bool* ok)
+{
+    if (!o) { *ok = false; return 0; }
+    long v = PyLong_AsLong(o);
+    if (v == -1 && PyErr_Occurred()) { PyErr_Clear(); *ok = false; return 0; }
+    return v;
+}
+
+/* PyFloat_AsDouble with the same contract (accepts any __float__-able) */
+static double as_double_checked(PyObject* o, bool* ok)
+{
+    if (!o) { *ok = false; return 0.0; }
+    double v = PyFloat_AsDouble(o);
+    if (v == -1.0 && PyErr_Occurred()) { PyErr_Clear(); *ok = false; return 0.0; }
+    return v;
+}
+
 } // namespace
 
 extern "C" {
@@ -104,13 +140,15 @@ void sirius_create_context(void** handler, int* error_code)
     }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("create_context", PyTuple_New(0));
-    if (r) {
-        *handler = reinterpret_cast<void*>(PyLong_AsLong(r));
-        Py_DECREF(r);
+    bool ok = true;
+    long h = as_long_checked(r, &ok);
+    if (r && ok) {
+        *handler = reinterpret_cast<void*>(h);
         set_err(error_code, 0);
     } else {
         set_err(error_code, 1);
     }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -334,13 +372,15 @@ void sirius_get_energy(void* handler, char const* label, double* value,
     PyObject* r = call("get_energy",
                        Py_BuildValue("(ls)", reinterpret_cast<long>(handler),
                                      label));
-    if (r) {
-        *value = PyFloat_AsDouble(r);
-        Py_DECREF(r);
+    bool ok = true;
+    double v = as_double_checked(r, &ok);
+    if (r && ok) {
+        *value = v;
         set_err(error_code, 0);
     } else {
         set_err(error_code, 1);
     }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -350,13 +390,15 @@ void sirius_get_num_atoms(void* handler, int* num_atoms, int* error_code)
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("get_num_atoms",
                        Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
-    if (r) {
-        *num_atoms = static_cast<int>(PyLong_AsLong(r));
-        Py_DECREF(r);
+    bool ok = true;
+    long v = as_long_checked(r, &ok);
+    if (r && ok) {
+        *num_atoms = static_cast<int>(v);
         set_err(error_code, 0);
     } else {
         set_err(error_code, 1);
     }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -368,8 +410,17 @@ static int fill_mat(PyObject* rows, double* out, int ncol)
     Py_ssize_t n = PyList_Size(rows);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject* row = PyList_GetItem(rows, i);
+        if (!row || !PyList_Check(row) || PyList_Size(row) < ncol) {
+            PyErr_Clear();
+            return 1;
+        }
         for (int j = 0; j < ncol; j++) {
-            out[i * ncol + j] = PyFloat_AsDouble(PyList_GetItem(row, j));
+            bool ok = true;
+            out[i * ncol + j] =
+                as_double_checked(PyList_GetItem(row, j), &ok);
+            if (!ok) {
+                return 1;
+            }
         }
     }
     return 0;
@@ -446,13 +497,15 @@ static void get_int_h(const char* fn, void* handler, int* value,
     std::lock_guard<std::mutex> lk(g_mutex);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call(fn, Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
-    if (r) {
-        *value = static_cast<int>(PyLong_AsLong(r));
-        Py_DECREF(r);
+    bool ok = true;
+    long v = as_long_checked(r, &ok);
+    if (r && ok) {
+        *value = static_cast<int>(v);
         set_err(error_code, 0);
     } else {
         set_err(error_code, 1);
     }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -489,13 +542,15 @@ void sirius_get_energy_fermi(void* handler, double* efermi, int* error_code)
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("get_efermi",
                        Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
-    if (r) {
-        *efermi = PyFloat_AsDouble(r);
-        Py_DECREF(r);
+    bool ok = true;
+    double v = as_double_checked(r, &ok);
+    if (r && ok) {
+        *efermi = v;
         set_err(error_code, 0);
     } else {
         set_err(error_code, 1);
     }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -547,10 +602,11 @@ void sirius_get_band_energies(void* handler, int const* ik, int const* ispn,
                                      *ik, *ispn));
     if (r && PyList_Check(r)) {
         Py_ssize_t n = PyList_Size(r);
-        for (Py_ssize_t i = 0; i < n; i++) {
-            energies[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+        bool ok = true;
+        for (Py_ssize_t i = 0; i < n && ok; i++) {
+            energies[i] = as_double_checked(PyList_GetItem(r, i), &ok);
         }
-        set_err(error_code, 0);
+        set_err(error_code, ok ? 0 : 1);
     } else {
         set_err(error_code, 1);
     }
@@ -588,10 +644,11 @@ void sirius_get_band_occupancies(void* handler, int const* ik,
                                      *ik, *ispn));
     if (r && PyList_Check(r)) {
         Py_ssize_t n = PyList_Size(r);
-        for (Py_ssize_t i = 0; i < n; i++) {
-            occ[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+        bool ok = true;
+        for (Py_ssize_t i = 0; i < n && ok; i++) {
+            occ[i] = as_double_checked(PyList_GetItem(r, i), &ok);
         }
-        set_err(error_code, 0);
+        set_err(error_code, ok ? 0 : 1);
     } else {
         set_err(error_code, 1);
     }
@@ -646,30 +703,16 @@ void sirius_option_get_number_of_sections(int* length, int* error_code)
     if (!ensure_python()) { set_err(error_code, 1); return; }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("option_get_number_of_sections", PyTuple_New(0));
-    if (r) { *length = (int)PyLong_AsLong(r); Py_DECREF(r); set_err(error_code, 0); }
-    else   { set_err(error_code, 1); }
+    bool ok = true;
+    long v = as_long_checked(r, &ok);
+    if (r && ok) { *length = (int)v; set_err(error_code, 0); }
+    else         { set_err(error_code, 1); }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
-static bool copy_str(PyObject* r, char* out, int out_len)
-{
-    /* tolerates r == NULL (missing dict key) — copies "" and reports
-     * false so callers that REQUIRE the field can flag the error */
-    const char* s = r ? PyUnicode_AsUTF8(r) : nullptr;
-    if (!s) PyErr_Clear();
-    std::snprintf(out, (size_t)out_len, "%s", s ? s : "");
-    return s != nullptr;
-}
-
-/* PyLong_AsLong with NULL/err tolerance: missing or non-int dict items
- * report through *ok instead of segfaulting the host process */
-static long as_long_checked(PyObject* o, bool* ok)
-{
-    if (!o) { *ok = false; return 0; }
-    long v = PyLong_AsLong(o);
-    if (v == -1 && PyErr_Occurred()) { PyErr_Clear(); *ok = false; return 0; }
-    return v;
-}
+/* copy_str / as_long_checked / as_double_checked are defined next to
+ * set_err at the top of this file (shared by every guarded getter) */
 
 void sirius_option_get_section_name(int elem, char* section_name, int section_name_length, int* error_code)
 {
@@ -688,8 +731,11 @@ void sirius_option_get_section_length(char const* section, int* length, int* err
     if (!ensure_python()) { set_err(error_code, 1); return; }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("option_get_section_length", Py_BuildValue("(s)", section));
-    if (r) { *length = (int)PyLong_AsLong(r); Py_DECREF(r); set_err(error_code, 0); }
-    else   { set_err(error_code, 1); }
+    bool ok = true;
+    long v = as_long_checked(r, &ok);
+    if (r && ok) { *length = (int)v; set_err(error_code, 0); }
+    else         { set_err(error_code, 1); }
+    Py_XDECREF(r);
     PyGILState_Release(st);
 }
 
@@ -782,9 +828,12 @@ void sirius_get_rg_dims(void* handler, int* dims, int* error_code)
     std::lock_guard<std::mutex> lk(g_mutex);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* r = call("get_rg_dims", Py_BuildValue("(l)", reinterpret_cast<long>(handler)));
-    if (r && PyList_Check(r)) {
-        for (int i = 0; i < 3; i++) dims[i] = (int)PyLong_AsLong(PyList_GetItem(r, i));
-        set_err(error_code, 0);
+    if (r && PyList_Check(r) && PyList_Size(r) >= 3) {
+        bool ok = true;
+        for (int i = 0; i < 3 && ok; i++) {
+            dims[i] = (int)as_long_checked(PyList_GetItem(r, i), &ok);
+        }
+        set_err(error_code, ok ? 0 : 1);
     } else { set_err(error_code, 1); }
     Py_XDECREF(r);
     PyGILState_Release(st);

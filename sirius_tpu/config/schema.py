@@ -56,6 +56,33 @@ class ControlConfig:
     # forces, False disables. sirius_tpu extension.
     beta_chunked: object = "auto"
     beta_chunk_budget_bytes: float = 2.0e9
+    # SCF supervision & recovery (dft/recovery.py): on non-finite fields,
+    # energy blow-up, or RMS growing for rms_divergence_iters consecutive
+    # iterations, roll back to the last finite snapshot and escalate the
+    # backoff ladder (flush mixer history -> halve beta / linear fallback
+    # -> disable device_scf) up to max_recoveries times before aborting
+    # with a structured diagnostic. sirius_tpu extension (the reference
+    # relies on robust direct-minimization solvers instead).
+    scf_supervision: bool = True
+    max_recoveries: int = 3
+    rms_divergence_iters: int = 8
+    energy_blowup_tol: float = 1e4  # Ha; |dE| beyond this trips the sentinel
+    # fused path: fetch the rollback snapshot every N iterations (the host
+    # path snapshots every iteration for free)
+    snapshot_every: int = 5
+    # band-solve supervision: retry with a deeper subspace when
+    # max residual norm exceeds band_residual_blowup; serial path falls
+    # back to dense exact diagonalization when ngk <= exact_diag_max_ngk
+    band_residual_blowup: float = 1e2
+    exact_diag_max_ngk: int = 600
+    # preemption safety: write an atomic mid-SCF checkpoint every N
+    # iterations (0 disables) to autosave_path (default
+    # <base_dir>/sirius_autosave.h5); run_scf(resume=path) restarts from it
+    autosave_every: int = 0
+    autosave_path: str = ""
+    # on abort, dump the supervisor diagnostic (sentinel, iteration,
+    # last-good energies, ladder history) as JSON to this path ("" = off)
+    diag_dump: str = ""
 
 
 @dataclasses.dataclass

@@ -79,7 +79,8 @@ S_NEL = 11  # electron count from rho_out (audit)
 S_MAG = 12  # total moment from m_out (pre-mix)
 S_V0 = 13  # Re veff(G=0)
 S_ENT = 14  # smearing entropy sum
-NUM_SCALARS = 15
+S_FINITE = 15  # 1.0 when the mixed vector and new potential are all-finite
+NUM_SCALARS = 16
 
 
 class FusedCarry(NamedTuple):
@@ -163,11 +164,30 @@ class FusedScf:
 
     # -- host <-> device edges -------------------------------------------
 
-    def init_carry(self, x_mix: np.ndarray, pot) -> FusedCarry:
+    def init_carry(self, x_mix: np.ndarray, pot,
+                   history: dict | None = None) -> FusedCarry:
         """Seed the carry from the host-side initial packed vector and the
-        initial potential (generated on the host once, before the loop)."""
+        initial potential (generated on the host once, before the loop).
+        `history` optionally restores a checkpointed mixer history
+        ({'mix_x': [m, nx], 'mix_f': [m, nx]} complex, oldest first) so a
+        resumed fused run continues the same Anderson trajectory."""
         x_re, x_im = split_cplx(np.asarray(x_mix))
         st = device_mixer_init(self.nx, self.max_history)
+        if history and "mix_x" in history:
+            hx = np.asarray(history["mix_x"])[-self.max_history:]
+            hf = np.asarray(history["mix_f"])[-self.max_history:]
+            m = hx.shape[0]
+            hx_re = np.asarray(st.hx_re).copy()
+            hx_im = np.asarray(st.hx_im).copy()
+            hf_re = np.asarray(st.hf_re).copy()
+            hf_im = np.asarray(st.hf_im).copy()
+            hx_re[:m], hx_im[:m] = np.real(hx), np.imag(hx)
+            hf_re[:m], hf_im[:m] = np.real(hf), np.imag(hf)
+            st = DeviceMixerState(
+                jnp.asarray(hx_re), jnp.asarray(hx_im),
+                jnp.asarray(hf_re), jnp.asarray(hf_im),
+                jnp.asarray(np.int32(m)),
+            )
         v_re, v_im = split_cplx(np.asarray(pot.veff_g))
         if self.polarized and pot.bz_g is not None:
             b_re, b_im = split_cplx(np.asarray(pot.bz_g))
@@ -180,6 +200,24 @@ class FusedScf:
             jnp.asarray(v_re), jnp.asarray(v_im),
             jnp.asarray(b_re), jnp.asarray(b_im),
         )
+
+    def fetch_state(self, carry: FusedCarry, with_history: bool = False):
+        """Host copy of the packed mixed vector (and optionally the mixer
+        history) from a carry — the rollback-snapshot / autosave fetch of
+        dft/recovery.py. Called OUTSIDE the scf::fused_step profile span:
+        it is an explicit, supervised host transfer, not per-iteration
+        traffic."""
+        x = np.asarray(carry.x_re) + 1j * np.asarray(carry.x_im)
+        if not with_history:
+            return x, None
+        m = int(np.asarray(carry.count))
+        hist = {}
+        if m > 0:
+            hist["mix_x"] = (np.asarray(carry.hx_re)[:m]
+                             + 1j * np.asarray(carry.hx_im)[:m])
+            hist["mix_f"] = (np.asarray(carry.hf_re)[:m]
+                             + 1j * np.asarray(carry.hf_im)[:m])
+        return x, hist
 
     def step(self, carry, acc, dm_re, dm_im, ev, occ_w, ent):
         """One fused iteration. acc: [ns, coarse box] occupation-weighted
@@ -315,10 +353,22 @@ class FusedScf:
 
         eval_sum = jnp.sum(occ_w * ev)
         e = pot["energies"]
+        # device-side health sentinel (dft/recovery.py): a NaN anywhere in
+        # the mixed vector or the new potential collapses every scalar to
+        # NaN anyway, but jnp.isfinite makes the check explicit and also
+        # catches an Inf confined to a single G component that the energy
+        # sums could mask by cancellation
+        finite = (
+            jnp.all(jnp.isfinite(jnp.real(x_mixed)))
+            & jnp.all(jnp.isfinite(jnp.imag(x_mixed)))
+            & jnp.all(jnp.isfinite(jnp.real(veff_new)))
+            & jnp.all(jnp.isfinite(jnp.imag(veff_new)))
+            & jnp.all(jnp.isfinite(ev))
+        ).astype(jnp.float64)
         scalars = jnp.stack([
             rms, eha, e["vha"], e["vxc"], e["vloc"], e["veff"], e["exc"],
             e["bxc"], e1, e2, eval_sum, nel_got, mag_moment, v0,
-            ent.astype(jnp.float64),
+            ent.astype(jnp.float64), finite,
         ])
 
         if self.polarized:

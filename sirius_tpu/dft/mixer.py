@@ -236,6 +236,30 @@ class Mixer:
             self._f.pop(0)
         return nxt
 
+    def flush_history(self) -> None:
+        """Drop the quasi-Newton history. Rung 0 of the recovery ladder
+        (dft/recovery.py): a history poisoned by a diverging trajectory is
+        the most common Anderson/Broyden divergence amplifier, and the next
+        mix() degrades gracefully to a plain damped step."""
+        self._x = []
+        self._f = []
+
+    def export_history(self) -> dict:
+        """(x, f) history as stacked arrays for checkpointing; empty dict
+        when there is no history yet. Restoring via import_history makes a
+        resumed host-path SCF bit-reproducible."""
+        if not self._x:
+            return {}
+        return {"mix_x": np.stack(self._x), "mix_f": np.stack(self._f)}
+
+    def import_history(self, hist: dict) -> None:
+        if "mix_x" not in hist:
+            self._x = []
+            self._f = []
+            return
+        self._x = [np.asarray(r) for r in hist["mix_x"]]
+        self._f = [np.asarray(r) for r in hist["mix_f"]]
+
 
 # ---------------------------------------------------------------------------
 # Device-resident mixer (the jitted twin of Mixer for the fused SCF step).

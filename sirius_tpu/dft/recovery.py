@@ -1,0 +1,230 @@
+"""SCF supervision & recovery: divergence sentinels and a backoff ladder.
+
+The reference answers SCF divergence with restartable ground states and
+"robust" direct-minimization solvers; long device-resident TPU loops add
+preemption and silent NaN propagation on top (PAPERS.md: the TPU DFT and
+quantum-chemistry papers both treat numerical-failure handling and
+checkpoint/restart as prerequisites for multi-hour runs). Previously
+run_scf raised a bare FloatingPointError at three sites (non-finite fused
+scalars, non-finite eigen/mixed vectors, non-finite potential) and lost
+the whole run.
+
+ScfSupervisor turns those sites into a bounded retry loop:
+
+  sentinel fires (non-finite field, energy blow-up, RMS growing for K
+  consecutive iterations)
+    -> roll back to the last finite (x_mix, energy) snapshot
+    -> escalate one rung of the backoff ladder:
+         rung 0: flush Anderson/Broyden history (a poisoned history is the
+                 most common divergence amplifier)
+         rung 1: flush + halve beta and fall back to linear mixing
+         rung 2: disable the fused device path for the remaining
+                 iterations (host path re-checks every field per iteration
+                 and runs the band solve under supervision)
+         rung 3+ (or recovery budget exhausted): abort with ScfAbortError
+                 carrying a structured diagnostic (sentinel, iteration,
+                 last-good energies, ladder history)
+
+run_scf owns the actual state mutation (restoring x_mix, rebuilding the
+potential and the fused program); the supervisor owns detection, the
+snapshot payload, escalation bookkeeping, and the diagnostic dump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+# ladder rung -> human-readable action (diagnostic / log strings)
+LADDER = (
+    "flush_history",
+    "halve_beta_linear",
+    "disable_device_scf",
+    "abort",
+)
+
+
+class ScfAbortError(FloatingPointError):
+    """SCF diverged beyond the recovery ladder. Subclasses
+    FloatingPointError so callers of the previous fatal behaviour keep
+    catching it; .diagnostic holds the structured dump."""
+
+    def __init__(self, message: str, diagnostic: dict):
+        super().__init__(message)
+        self.diagnostic = diagnostic
+
+
+@dataclasses.dataclass
+class RecoveryDirective:
+    """What run_scf must do after a rollback, one ladder escalation."""
+
+    rung: int
+    flush_history: bool = False
+    beta: float | None = None  # new mixer beta (None = keep)
+    kind: str | None = None  # new mixer kind (None = keep)
+    disable_device: bool = False
+
+
+class ScfSupervisor:
+    """Watches per-iteration scalars, keeps the last finite snapshot, and
+    hands out ladder directives when a sentinel fires."""
+
+    def __init__(self, control, mixer_beta: float, mixer_kind: str,
+                 deck_label: str = ""):
+        self.enabled = bool(getattr(control, "scf_supervision", True))
+        self.max_recoveries = int(getattr(control, "max_recoveries", 3))
+        self.rms_divergence_iters = int(
+            getattr(control, "rms_divergence_iters", 8))
+        self.energy_blowup_tol = float(
+            getattr(control, "energy_blowup_tol", 1e4))
+        self.diag_dump = str(getattr(control, "diag_dump", ""))
+        self.deck_label = deck_label
+        self.beta0 = float(mixer_beta)
+        self.kind0 = str(mixer_kind)
+        self.rung = 0
+        self.recoveries = 0
+        self.history: list[dict] = []  # one entry per recovery event
+        # rollback payload: dict set by run_scf via snapshot()
+        self._snap: dict | None = None
+        self._rms_streak = 0
+        self._streak_start_rms = None
+        self._e_prev = None
+        self._etot_tail: list[float] = []
+        self._rms_tail: list[float] = []
+
+    # -- snapshot ---------------------------------------------------------
+
+    def snapshot(self, it: int, payload: dict) -> None:
+        """Record the last-known-finite state. `payload` must contain
+        everything run_scf needs to roll back (at minimum a host copy of
+        the packed mixed vector under 'x_mix'); ownership transfers here —
+        pass copies."""
+        self._snap = {"it": it, **payload}
+
+    @property
+    def has_snapshot(self) -> bool:
+        return self._snap is not None
+
+    @property
+    def snap(self) -> dict:
+        if self._snap is None:
+            raise RuntimeError("no snapshot recorded")
+        return self._snap
+
+    # -- sentinels --------------------------------------------------------
+
+    def observe(self, it: int, rms: float, e_total: float) -> str | None:
+        """Feed one finished iteration's scalars; returns the sentinel name
+        if a soft-divergence condition fired, else None. (Hard non-finite
+        sentinels are reported directly via recover().)"""
+        self._etot_tail = (self._etot_tail + [float(e_total)])[-8:]
+        self._rms_tail = (self._rms_tail + [float(rms)])[-8:]
+        if not self.enabled:
+            self._e_prev = e_total
+            return None
+        if self._e_prev is not None and np.isfinite(e_total) and np.isfinite(
+                self._e_prev):
+            if abs(e_total - self._e_prev) > self.energy_blowup_tol:
+                self._e_prev = e_total
+                return "energy_blowup"
+        self._e_prev = e_total
+        # RMS divergence: K consecutive growing iterations AND an order of
+        # magnitude above where the streak started (plain non-monotone
+        # Anderson steps must not trip it)
+        if self._rms_tail[:-1] and rms > self._rms_tail[-2]:
+            if self._rms_streak == 0:
+                self._streak_start_rms = self._rms_tail[-2]
+            self._rms_streak += 1
+        else:
+            self._rms_streak = 0
+            self._streak_start_rms = None
+        if (self._rms_streak >= self.rms_divergence_iters
+                and self._streak_start_rms is not None
+                and rms > 10.0 * max(self._streak_start_rms, 1e-300)):
+            self._rms_streak = 0
+            self._streak_start_rms = None
+            return "rms_divergence"
+        return None
+
+    def reset_trend(self) -> None:
+        """Clear soft-sentinel trend state after a rollback (the restored
+        iterate restarts the energy/rms trajectory)."""
+        self._rms_streak = 0
+        self._streak_start_rms = None
+        self._e_prev = None
+
+    # -- recovery ---------------------------------------------------------
+
+    def recover(self, sentinel: str, it: int, detail: str = "",
+                state: dict | None = None) -> RecoveryDirective:
+        """A sentinel fired at iteration `it`. Escalate one ladder rung and
+        return the directive; raises ScfAbortError when the ladder (or the
+        recovery budget, or the absence of any snapshot) is exhausted."""
+        if (not self.enabled or self._snap is None
+                or self.recoveries >= self.max_recoveries
+                or self.rung >= len(LADDER) - 1):
+            raise self._abort(sentinel, it, detail, state)
+        rung = self.rung
+        action = LADDER[rung]
+        self.rung += 1
+        self.recoveries += 1
+        self.history.append({
+            "iteration": it,
+            "sentinel": sentinel,
+            "detail": detail,
+            "rung": rung,
+            "action": action,
+            "rolled_back_to": self._snap["it"],
+        })
+        d = RecoveryDirective(rung=rung, flush_history=True)
+        if rung >= 1:
+            d.beta = 0.5 * self.beta0
+            d.kind = "linear"
+        if rung >= 2:
+            d.disable_device = True
+        self.reset_trend()
+        return d
+
+    def _abort(self, sentinel: str, it: int, detail: str,
+               state: dict | None) -> ScfAbortError:
+        diag = self.diagnostic(sentinel, it, detail, state)
+        if self.diag_dump:
+            try:
+                with open(self.diag_dump, "w") as f:
+                    json.dump(diag, f, indent=2, default=str)
+            except OSError:
+                pass
+        last_good = self._snap["it"] if self._snap is not None else None
+        return ScfAbortError(
+            f"SCF aborted at iteration {it}: sentinel '{sentinel}' fired "
+            f"after {self.recoveries} recoveries "
+            f"(last good iteration: {last_good})"
+            + (f"; {detail}" if detail else ""),
+            diag,
+        )
+
+    def diagnostic(self, sentinel: str, it: int, detail: str = "",
+                   state: dict | None = None) -> dict:
+        diag = {
+            "sentinel": sentinel,
+            "iteration": it,
+            "deck": self.deck_label,
+            "recoveries": self.recoveries,
+            "rung": self.rung,
+            "ladder_history": list(self.history),
+            "etot_tail": list(self._etot_tail),
+            "rms_tail": list(self._rms_tail),
+            "last_good_iteration": (
+                self._snap["it"] if self._snap is not None else None),
+            "last_good_energy": (
+                self._snap.get("e_total") if self._snap is not None
+                else None),
+            "mixer_beta0": self.beta0,
+            "mixer_kind0": self.kind0,
+            "detail": detail,
+        }
+        if state:
+            diag.update(state)
+        return diag

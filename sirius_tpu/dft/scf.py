@@ -9,6 +9,7 @@ from the previous iteration's wave functions.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -28,12 +29,14 @@ from sirius_tpu.dft.density import (
 from sirius_tpu.dft.mixer import Mixer, schedule_res_tol
 from sirius_tpu.dft.occupation import find_fermi
 from sirius_tpu.dft.potential import generate_potential
+from sirius_tpu.dft.recovery import ScfSupervisor
 from sirius_tpu.dft.xc import XCFunctional
 from sirius_tpu.ops.atomic import atomic_orbitals
 from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
 from sirius_tpu.utils import checksums as _cks
+from sirius_tpu.utils import faults
 from sirius_tpu.utils.profiler import counters, profile, timer_report
 
 
@@ -102,6 +105,7 @@ def run_scf(
     initial_state: dict | None = None,
     keep_state: bool = False,
     serial_bands: bool = False,
+    resume: str | None = None,
 ) -> dict:
     """initial_state: optional in-memory warm start {rho_g, mag_g, psi}
     (e.g. the `_state` of a previous run_scf at nearby atomic positions,
@@ -109,11 +113,19 @@ def run_scf(
     state to the result as `_state` (costs a host copy of all wave
     functions; only geometry drivers ask for it). serial_bands: use the
     per-(k, spin) debug path instead of the production one-program batched
-    k-set solve (parallel/batched.py)."""
+    k-set solve (parallel/batched.py). resume: path to a mid-SCF autosave
+    (control.autosave_every) — restarts the loop at the saved iteration
+    with the full mixer/wave-function/tolerance state, bit-reproducibly on
+    the host path; unlike restart_from (density-only warm start of a NEW
+    run), resume continues the SAME run after preemption."""
     t0 = time.time()
     from sirius_tpu.utils.profiler import reset_timers
 
     reset_timers()
+    if os.environ.get("SIRIUS_TPU_FAULTS"):
+        # child processes (tools/soak_scf.py) inherit their fault plan via
+        # the environment; in-process plans (faults.install) are untouched
+        faults.load_env()
     p = cfg.parameters
     if ctx is None:
         ctx = SimulationContext.create(cfg, base_dir)
@@ -224,6 +236,19 @@ def run_scf(
             mag_g = state.get("mag_g", mag_g)
         if paw is not None and state.get("paw_dm") is not None:
             paw_dm = np.asarray(state["paw_dm"])
+    resume_scf = None
+    _resume_psi = None
+    if resume:
+        from sirius_tpu.io.checkpoint import load_state
+
+        state = load_state(resume, ctx)
+        rho_g = state["rho_g"]
+        if polarized and state.get("mag_g") is not None:
+            mag_g = state["mag_g"]
+        if paw is not None and state.get("paw_dm") is not None:
+            paw_dm = np.asarray(state["paw_dm"])
+        resume_scf = state.get("scf")
+        _resume_psi = state.get("psi")
     psi = None
     if initial_state is not None:
         rho_g = np.asarray(initial_state["rho_g"])
@@ -236,6 +261,12 @@ def run_scf(
             nk, ns, nb, ctx.gkvec.ngk_max,
         ):
             psi = np.asarray(prev_psi) * ctx.gkvec.mask[:, None, None, :]
+    if _resume_psi is not None and _resume_psi.shape == (
+        nk, ns, nb, ctx.gkvec.ngk_max,
+    ):
+        # the autosaved wave functions warm-start the resumed band solve —
+        # required for bit-reproducible host-path continuation
+        psi = np.asarray(_resume_psi) * ctx.gkvec.mask[:, None, None, :]
     # first PAW on-site update (from the file-occupation guess or the
     # restored/warm-started dm)
     paw_res = paw_mod.compute_paw(paw, paw_dm, xc) if paw is not None else None
@@ -557,6 +588,56 @@ def run_scf(
     # a static bar leaves a locked-band noise floor in the density that can
     # sit just above density_tol and stall tight decks at num_dft_iter
     res_tol = itsol.residual_tolerance
+    it0 = 0
+    if resume_scf is not None:
+        # --- mid-SCF resume (control.autosave_every checkpoints): restore
+        # the packed mixed vector, mixer history/backoff state, adaptive
+        # tolerance, convergence histories and the iteration counter, then
+        # rebuild everything derived (hub/PAW on-site state, potential).
+        # With psi also restored above, the host path replays the exact
+        # trajectory of the uninterrupted run. ---
+        if mgga:
+            raise NotImplementedError(
+                "mid-SCF resume with mGGA (tau is not checkpointed)")
+        x_mix = np.asarray(resume_scf["x_mix"])
+        rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, lam_mixed = unpack(x_mix)
+        if lam_mixed is not None:
+            hub_lagrange = lam_mixed
+        if hub is not None:
+            um_local, um_nl, e_hub, _ = hubbard_potential_and_energy(
+                hub, om_mixed, ctx.max_occupancy, om_nl=om_nl_mixed,
+                lagrange=hub_lagrange if hub_cons_active else None,
+                om_cons=hub_om_cons if hub_cons_active else None,
+            )
+            vhub = np.stack([
+                u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
+                for ik in range(nk)
+            ])
+        if paw is not None:
+            paw_res = paw_mod.compute_paw(paw, paw_dm, xc)
+            e_paw_one_el = paw_mod.one_elec_energy(
+                paw, paw_dm, paw_res["dij_atoms"])
+        with profile("scf::potential"):
+            pot = generate_potential(ctx, rho_g, xc, mag_g)
+        mixer.import_history(resume_scf)
+        mixer.beta = float(resume_scf.get("mix_beta", mixer.beta))
+        mixer.kind = str(resume_scf.get("mix_kind", mixer.kind))
+        res_tol = float(resume_scf.get("res_tol", res_tol))
+        if "e_prev" in resume_scf:
+            e_prev = float(resume_scf["e_prev"])
+        etot_history = [float(v) for v in resume_scf.get("etot_history", [])]
+        rms_history = [float(v) for v in resume_scf.get("rms_history", [])]
+        mag_history = [float(v) for v in resume_scf.get("mag_history", [])]
+        if "evals" in resume_scf:
+            evals = np.asarray(resume_scf["evals"], dtype=np.float64)
+        it0 = int(resume_scf.get("iteration", 0))
+        num_iter_done = it0
+        # honour an fp32 -> fp64 polish switch that fired before the save
+        wf_dtype = (
+            jnp.complex128
+            if bool(resume_scf.get("wf_fp64", p.precision_wf == "fp64"))
+            else jnp.complex64
+        )
 
     # ---- fused device-resident iteration (dft/fused.py): density ->
     # mixer -> potential -> D/H-diag refresh as ONE compiled program with a
@@ -574,8 +655,8 @@ def run_scf(
     ):
         from sirius_tpu.dft.fused import (
             FusedScf,
-            S_BXC, S_E1, S_E2, S_EHA, S_ENT, S_EVAL, S_EXC, S_MAG, S_NEL,
-            S_RMS, S_V0, S_VHA, S_VXC,
+            S_BXC, S_E1, S_E2, S_EHA, S_ENT, S_EVAL, S_EXC, S_FINITE, S_MAG,
+            S_NEL, S_RMS, S_V0, S_VHA, S_VXC,
         )
 
         if scf_mesh is not None:
@@ -599,11 +680,27 @@ def run_scf(
 
         if beta_dev is not None:
             beta_dev = _repl(beta_dev)
-        fused = FusedScf(ctx, xc, mixer, polarized, do_symmetrize,
-                         beta_dev=beta_dev)
-        fused.tables = _repl(fused.tables)
-        fused.kweights_dev = _repl(fused.kweights_dev)
-        fused_carry = _repl(fused.init_carry(x_mix, pot))
+
+        def _fused_setup(x0, pot0, history=None, rebuild=True):
+            # (re)build the fused program and/or its carry. The recovery
+            # ladder calls this after a rollback: the donated carry of a
+            # diverged step holds poisoned buffers, and a beta/kind change
+            # needs a full rebuild because FusedScf bakes mixer.beta and
+            # mixer.kind into the trace.
+            nonlocal fused, fused_carry, fused_out, fused_np
+            if rebuild or fused is None:
+                fused = FusedScf(ctx, xc, mixer, polarized, do_symmetrize,
+                                 beta_dev=beta_dev)
+                fused.tables = _repl(fused.tables)
+                fused.kweights_dev = _repl(fused.kweights_dev)
+            fused_carry = _repl(fused.init_carry(x0, pot0, history=history))
+            fused_out = fused_np = None
+
+        _fused_setup(
+            x_mix, pot,
+            history=mixer.export_history() or None
+            if resume_scf is not None else None,
+        )
         # pre-wrapped device scalars: python floats fed to jit are implicit
         # host->device transfers, which the fused loop must not make
         fused_nel = _repl(jnp.asarray(float(nel), dtype=jnp.float64))
@@ -617,7 +714,145 @@ def run_scf(
             (jnp.zeros((ns, 0, 0)), jnp.zeros((ns, 0, 0)))
         )
 
-    for it in range(p.num_dft_iter):
+    # ---- SCF supervision & recovery (dft/recovery.py): the sentinels
+    # below (non-finite fields, energy blow-up, RMS divergence) roll the
+    # loop back to the last finite snapshot and escalate a backoff ladder
+    # instead of raising a fatal FloatingPointError. ----
+    sup = ScfSupervisor(
+        cfg.control, mixer.beta, mixer.kind,
+        deck_label=f"nk={nk} ns={ns} nb={nb} ng={ng}",
+    )
+    _snap_every = max(1, int(getattr(cfg.control, "snapshot_every", 5)))
+    _autosave_every = int(getattr(cfg.control, "autosave_every", 0))
+    if sup.enabled:
+        # rollback target before any iteration ran: the initial guess
+        sup.snapshot(-1, {"x_mix": np.array(x_mix), "res_tol": res_tol})
+
+    def _recover(sentinel, detail=""):
+        """Roll back to the supervisor's snapshot and apply one ladder
+        rung. Raises ScfAbortError (with the structured diagnostic) when
+        the ladder or the recovery budget is exhausted."""
+        nonlocal x_mix, rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm
+        nonlocal hub_lagrange, um_local, um_nl, e_hub, vhub
+        nonlocal paw_res, e_paw_one_el, pot, psi, psi_big, pr, pi
+        nonlocal x_packed, tau_g, fused, fused_carry, fused_out, fused_np
+        nonlocal e_prev, res_tol
+        if os.environ.get("SIRIUS_TPU_DUMP_DIVERGED"):
+            np.savez(
+                os.environ["SIRIUS_TPU_DUMP_DIVERGED"],
+                rho_g=rho_g,
+                mag_g=mag_g if mag_g is not None else np.zeros(1),
+            )
+        d = sup.recover(sentinel, it, detail=detail, state={
+            "mixer_beta": mixer.beta, "mixer_kind": mixer.kind,
+            "device_scf": fused is not None,
+        })
+        if cfg.control.verbosity >= 1:
+            print(
+                f"[scf] recovery at it={it + 1}: sentinel '{sentinel}' -> "
+                f"rung {d.rung} (rollback to it="
+                f"{sup.snap['it'] + 1})", flush=True,
+            )
+        snap = sup.snap
+        x_mix = np.array(snap["x_mix"])
+        if d.flush_history:
+            mixer.flush_history()
+        if d.beta is not None:
+            mixer.beta = d.beta
+        if d.kind is not None:
+            mixer.kind = d.kind
+        res_tol = float(snap.get("res_tol", itsol.residual_tolerance))
+        e_prev = None
+        rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, _lam = unpack(x_mix)
+        if _lam is not None:
+            hub_lagrange = _lam
+        if hub is not None:
+            um_local, um_nl, e_hub, _ = hubbard_potential_and_energy(
+                hub, om_mixed, ctx.max_occupancy, om_nl=om_nl_mixed,
+                lagrange=hub_lagrange if hub_cons_active else None,
+                om_cons=hub_om_cons if hub_cons_active else None,
+            )
+            vhub = np.stack([
+                u_matrix_for_k(hub, um_local, um_nl, ctx.gkvec.kpoints[ik])
+                for ik in range(nk)
+            ])
+        if paw is not None:
+            paw_res = paw_mod.compute_paw(paw, paw_dm, xc)
+            e_paw_one_el = paw_mod.one_elec_energy(
+                paw, paw_dm, paw_res["dij_atoms"])
+        if mgga:
+            # tau of the diverged wave functions is poisoned too; restart
+            # from the tau = 0 bootstrap like the initial iteration
+            tau_g = np.zeros((ns, ng), dtype=np.complex128)
+        with profile("scf::potential"):
+            pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
+        # the diverged wave functions are part of the poisoned trajectory:
+        # restart the band solve from a fresh LCAO subspace
+        psi = None
+        pr = pi = None
+        x_packed = [None] * ns
+        if gsh is not None:
+            gsh["psi"] = None
+        psi_big = _initial_subspace(ctx)
+        if fused is not None:
+            if d.disable_device:
+                # rung 2: remaining iterations on the host path, which
+                # re-validates every field per iteration
+                fused = None
+                fused_carry = fused_out = fused_np = None
+            else:
+                _fused_setup(
+                    x_mix, pot,
+                    rebuild=(d.beta is not None or d.kind is not None),
+                )
+
+    def _autosave(it):
+        """Atomic mid-SCF checkpoint (io/checkpoint.py scf_state group):
+        everything the resume path above needs to continue this run."""
+        from sirius_tpu.io.checkpoint import save_state
+
+        path = cfg.control.autosave_path or os.path.join(
+            base_dir, "sirius_autosave.h5")
+        if fused is not None and fused_carry is not None:
+            x_now, hist = fused.fetch_state(fused_carry, with_history=True)
+            ev_h = np.asarray(ev_dev, dtype=np.float64)
+        else:
+            x_now = np.array(x_mix)
+            hist = mixer.export_history()
+            ev_h = np.asarray(evals)
+        if pr is not None:
+            from sirius_tpu.parallel.batched import join_cplx as _jc
+
+            psi_h = np.asarray(_jc(pr, pi), dtype=np.complex128)
+        elif psi is not None:
+            psi_h = np.asarray(psi, dtype=np.complex128)
+        else:
+            psi_h = None
+        r_s, m_s, _, _, pdm_s, _ = unpack(x_now)
+        scf_state = {
+            "x_mix": x_now,
+            "iteration": it + 1,
+            "res_tol": res_tol,
+            "e_prev": e_prev,
+            "mix_beta": mixer.beta,
+            "mix_kind": mixer.kind,
+            "wf_fp64": wf_dtype == jnp.complex128,
+            "evals": ev_h,
+            "etot_history": np.asarray(etot_history),
+            "rms_history": np.asarray(rms_history),
+            "mag_history": np.asarray(mag_history),
+        }
+        if hist:
+            scf_state.update(hist)
+        save_state(
+            path, ctx, r_s, m_s, psi=psi_h, band_energies=ev_h,
+            paw_dm=pdm_s, scf_state=scf_state,
+        )
+        # fault site: a preemption right after the autosave (soak test /
+        # tests drive the resume path through this)
+        faults.check("scf.autosave_kill", it)
+
+    for it in range(it0, p.num_dft_iter):
         # --- band solve per (k, spin) (warm start) ---
         if fused is None or fused_out is None:
             # host D/v0 from the host potential; once the fused step has
@@ -964,6 +1199,13 @@ def run_scf(
                         num_steps=itsol.num_steps,
                         res_tol=res_tol,
                     )
+                # canonicalize the pair onto the explicit psi sharding (a
+                # no-op when GSPMD already placed it there): downstream
+                # consumers must see the SAME placement whether psi came
+                # from this solve or from a mid-SCF resume warm start,
+                # or the executables (and their reduction orders) differ
+                # and break bit-reproducible resume
+                pr, pi = _place_psi(pr), _place_psi(pi)
                 # psi stays device-resident as the (pr, pi) pair between
                 # iterations; the complex host copy is materialized only for
                 # consumers that need it (Hubbard occupations each
@@ -981,6 +1223,95 @@ def run_scf(
             counters["num_loc_op_applied"] += nk * ns * num_applies(
                 itsol.num_steps, nb
             )
+        # --- band-solve supervision (dft/recovery.py): a stagnated or
+        # blown-up solve is retried with a deeper subspace; the serial
+        # debug path additionally falls back to dense diagonalization for
+        # small |G+k| spheres (the reference's "robust" exact-solver
+        # escape hatch). Host paths only — the fused loop's scalar record
+        # already carries an all-finite eigenvalue sentinel, and checking
+        # rn here would add per-iteration device->host traffic. On the
+        # serial multi-k path rn covers the last (k, spin) solve, a proxy
+        # that still catches whole-solve stagnation.
+        if fused is None and sup.enabled:
+            from sirius_tpu.solvers.davidson import residual_health
+
+            rn_max, rn_ok = residual_health(
+                rn, blowup=cfg.control.band_residual_blowup)
+            if faults.armed("scf.band_stagnate", it):
+                rn_ok = False
+            if not rn_ok:
+                rescued = False
+                if (not serial_bands and not gamma_bands and gsh is None
+                        and bchunk is None and not mgga):
+                    # batched production path: one deeper retry, warm-
+                    # started from the stagnated block (static num_steps
+                    # means this compiles once and is then cached)
+                    from sirius_tpu.parallel.batched import (
+                        davidson_kset as _dk,
+                        join_cplx as _jcx,
+                    )
+
+                    ev, pr, pi, rn = _dk(
+                        ps, pr, pi, num_steps=2 * itsol.num_steps,
+                        res_tol=res_tol,
+                    )
+                    evals = np.asarray(ev, dtype=np.float64)
+                    if hub is not None:
+                        psi = _jcx(pr, pi)
+                    rescued = True
+                elif serial_bands and int(ctx.gkvec.ngk_max) <= int(
+                        cfg.control.exact_diag_max_ngk):
+                    from sirius_tpu.solvers.eigen import (
+                        build_h_s_matrices,
+                        exact_diag,
+                    )
+
+                    try:
+                        psi_r = np.asarray(psi, dtype=np.complex128).copy()
+                        qmat = (
+                            None if ctx.beta.qmat is None
+                            else np.asarray(ctx.beta.qmat)
+                        )
+                        for ik in range(nk):
+                            n_gk = int(ctx.gkvec.num_gk[ik])
+                            gkd = {
+                                "millers": np.asarray(
+                                    ctx.gkvec.millers[ik][:n_gk]),
+                                "ekin": np.asarray(
+                                    ctx.gkvec.kinetic()[ik][:n_gk]),
+                            }
+                            bk = (
+                                np.asarray(ctx.beta.beta_gk[ik])
+                                if ctx.beta.num_beta_total else None
+                            )
+                            for ispn in range(ns):
+                                vg = np.asarray(pot.veff_g)
+                                if polarized and pot.bz_g is not None:
+                                    vg = vg + np.asarray(
+                                        pot.bz_g if ispn == 0 else -pot.bz_g
+                                    )
+                                h, s = build_h_s_matrices(
+                                    gkd, vg, ctx.gvec.index_of_millers,
+                                    beta_k=bk,
+                                    dion=np.asarray(d_by_spin[ispn]),
+                                    qmat=qmat,
+                                )
+                                ev_d, vec = exact_diag(h, s, nb)
+                                evals[ik, ispn] = ev_d
+                                psi_r[ik, ispn] = 0.0
+                                psi_r[ik, ispn, :nb, :n_gk] = vec.T
+                        psi = psi_r
+                        rescued = True
+                    except ValueError:
+                        # fine G set lacks some G-G' differences
+                        # (pw_cutoff < 2*gk_cutoff): keep the iterative
+                        # result rather than build a truncated dense H
+                        pass
+                if rescued and cfg.control.verbosity >= 1:
+                    print(
+                        f"[scf] band-solve rescue at it={it + 1} "
+                        f"(max rnorm {rn_max:.2e})", flush=True,
+                    )
         if _cks.enabled():
             _cks.checksum("evals", evals)
 
@@ -1000,6 +1331,10 @@ def run_scf(
                 )
 
                 acc = density_kset(ps, pr, pi, occ_w)
+                # fault site: NaN into the accumulated density (functional
+                # device-side update; a no-op dict lookup when unarmed, so
+                # the transfer-guard contract of this span is preserved)
+                acc = faults.corrupt("scf.density", it, acc)
                 if fused.has_aug and beta_dev is not None:
                     dm_re, dm_im = density_matrix_kset(
                         *beta_dev, pr, pi, occ_w
@@ -1012,13 +1347,16 @@ def run_scf(
                 )
             # the ONLY per-iteration device->host fetch
             fused_np = np.asarray(fused_out["scalars"])
-            if not np.all(np.isfinite(fused_np)):
-                raise FloatingPointError(
-                    f"SCF diverged at iteration {it + 1}: non-finite "
-                    "scalars from the device-resident step (try smaller "
-                    "mixer.beta, or control.device_scf = false to debug "
-                    "on the host path)"
+            if (not np.all(np.isfinite(fused_np))
+                    or fused_np[S_FINITE] != 1.0):
+                # non-finite fields on device: roll back and escalate
+                # (dft/recovery.py) instead of losing the run
+                _recover(
+                    "device_nonfinite",
+                    detail="non-finite scalars/fields from the "
+                    "device-resident step",
                 )
+                continue
             rms = float(fused_np[S_RMS])
             eha_res = float(fused_np[S_EHA])
             dens_metric = eha_res if mixer.use_hartree else rms
@@ -1054,6 +1392,20 @@ def run_scf(
                     f"rms={rms:.3e}{mg}",
                     flush=True,
                 )
+            sentinel = sup.observe(it, rms, e_total)
+            if sentinel is not None:
+                _recover(sentinel)
+                continue
+            if sup.enabled and it % _snap_every == 0:
+                # rollback snapshot: fetch the mixed vector from the carry
+                # OUTSIDE the fused profile span (an explicit supervised
+                # transfer every snapshot_every iterations, not hidden
+                # per-iteration traffic)
+                x_snap, _ = fused.fetch_state(fused_carry)
+                sup.snapshot(it, {
+                    "x_mix": x_snap, "e_total": e_total,
+                    "res_tol": res_tol,
+                })
             de = abs(e_total - e_prev) if e_prev is not None else np.inf
             e_prev = e_total
             if (
@@ -1063,12 +1415,20 @@ def run_scf(
             ):
                 wf_dtype = jnp.complex128
                 continue
+            # autosave AFTER e_prev/precision bookkeeping: the saved state
+            # must be exactly what the next iteration of an uninterrupted
+            # run would start from
+            if _autosave_every and (it + 1) % _autosave_every == 0:
+                _autosave(it)
             if de < p.energy_tol and dens_metric < p.density_tol:
                 converged = True
                 break
             continue
 
         # --- occupations ---
+        # fault site: NaN into the band energies (detected with the other
+        # non-finite fields after the density assembly below)
+        evals = faults.corrupt("scf.evals", it, evals)
         mu, occ, entropy_sum = find_fermi(
             jnp.asarray(evals),
             jnp.asarray(ctx.kweights),
@@ -1196,6 +1556,9 @@ def run_scf(
         paw_dm_new = (
             paw.dm_from_density_matrix(dm_by_spin) if paw is not None else None
         )
+        # fault site: NaN into the freshly accumulated density (drives the
+        # recovery-ladder tests without waiting for a real divergence)
+        rho_new = faults.corrupt("scf.density", it, rho_new)
         x_new = pack(rho_new, mag_new, om_new, om_nl_new, paw_dm_new,
                      hub_lagrange)
         rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
@@ -1218,10 +1581,8 @@ def run_scf(
                 ]
                 if not np.all(np.isfinite(np.asarray(a)))
             ]
-            raise FloatingPointError(
-                f"SCF diverged at iteration {it + 1}: non-finite {bad} "
-                "(try smaller mixer.beta or a better initial guess)"
-            )
+            _recover("nonfinite_fields", detail=f"non-finite {bad}")
+            continue
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
         # density criterion in the reference's metric: with use_hartree the
@@ -1270,20 +1631,17 @@ def run_scf(
         # --- potential + energies ---
         with profile("scf::potential"):
             pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
+        # fault site: NaN into the generated effective potential
+        pot.veff_r_coarse = faults.corrupt(
+            "scf.potential", it, pot.veff_r_coarse)
         if not np.all(np.isfinite(np.asarray(pot.veff_r_coarse))):
-            import os as _os
-
-            if _os.environ.get("SIRIUS_TPU_DUMP_DIVERGED"):
-                np.savez(
-                    _os.environ["SIRIUS_TPU_DUMP_DIVERGED"],
-                    rho_g=rho_g,
-                    mag_g=mag_g if mag_g is not None else np.zeros(1),
-                )
-            raise FloatingPointError(
-                f"potential non-finite at iteration {it + 1} from finite "
-                f"density (rho finite={np.all(np.isfinite(rho_g))}, "
-                f"mag finite={mag_g is None or np.all(np.isfinite(mag_g))})"
+            _recover(
+                "potential_nonfinite",
+                detail=f"potential non-finite from rho finite="
+                f"{np.all(np.isfinite(rho_g))}, mag finite="
+                f"{mag_g is None or np.all(np.isfinite(mag_g))}",
             )
+            continue
         if _cks.enabled():
             _cks.checksum("veff", pot.veff_g)
         scf_correction = (
@@ -1314,6 +1672,17 @@ def run_scf(
                 flush=True,
             )
 
+        sentinel = sup.observe(it, rms, e_total)
+        if sentinel is not None:
+            _recover(sentinel)
+            continue
+        if sup.enabled:
+            # host path: the snapshot is a cheap host copy — keep the last
+            # finite post-mix state every iteration
+            sup.snapshot(it, {
+                "x_mix": np.array(x_mix), "e_total": e_total,
+                "res_tol": res_tol,
+            })
         de = abs(e_total - e_prev) if e_prev is not None else np.inf
         e_prev = e_total
         # fp32 -> fp64 polish switch (reference settings.fp32_to_fp64_rms);
@@ -1328,6 +1697,11 @@ def run_scf(
             if gsh is not None:
                 gsh["psi"] = None  # rebuild the sharded block in fp64
             continue
+        # autosave AFTER e_prev/precision bookkeeping: the saved state must
+        # be exactly what the next iteration of an uninterrupted run would
+        # start from (resume-equality is asserted bit-exact on this path)
+        if _autosave_every and (it + 1) % _autosave_every == 0:
+            _autosave(it)
         if de < p.energy_tol and dens_metric < p.density_tol:
             converged = True
             break
@@ -1379,6 +1753,13 @@ def run_scf(
         "etot_history": etot_history,
         "rms_history": rms_history,
         "mag_history": mag_history,
+        # supervision record (dft/recovery.py): empty ladder_history means
+        # the run never needed a rollback
+        "recovery": {
+            "recoveries": sup.recoveries,
+            "rung": sup.rung,
+            "ladder_history": list(sup.history),
+        },
         "scf_time": time.time() - t0,
         "energy": {
             "total": e_total,

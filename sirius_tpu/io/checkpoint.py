@@ -3,16 +3,60 @@ Density::save/load, Potential::save/load writing PW coefficient arrays,
 density.hpp:603-630; task ground_state_restart reloads rho/V and re-runs
 SCF, sirius.scf.cpp:147-155).
 
-Layout:
-  /meta: miller indices + lattice (to validate compatibility on load)
+Layout (schema version 2):
+  /meta: miller indices + lattice (to validate compatibility on load),
+         schema version + sha256 content checksum attrs
   /density/rho_g, /density/mag_g (optional)
   /potential/veff_g, /potential/bz_g (optional)
   /kset/psi, /kset/band_energies, /kset/band_occupancies (optional)
+  /scf: mid-SCF resume state (optional; run_scf control.autosave_every):
+        packed mixed vector, mixer history, residual tolerance, iteration
+        counter and convergence histories — enough to restart an SCF run
+        mid-loop bit-reproducibly on the host path.
+
+Writes are preemption-safe: the file is written to a same-directory temp
+path and atomically os.replace()d over the target, so a kill mid-save never
+leaves a corrupt or half-written checkpoint — the previous snapshot stays
+loadable. Loads verify a sha256 over every dataset and raise
+CheckpointError naming the field that failed validation.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
+
 import numpy as np
+
+# bump when the layout changes incompatibly; absence of the attr means a
+# pre-versioning (v1) file, which is still loadable
+SCHEMA_VERSION = 2
+
+
+class CheckpointError(ValueError):
+    """Checkpoint missing, corrupt, or incompatible with the current
+    context. Subclasses ValueError so pre-existing callers that caught the
+    old bare ValueError keep working."""
+
+
+def _content_digest(f) -> str:
+    """sha256 over every dataset (name, shape, dtype, bytes) in the file,
+    walked in sorted order so the digest is layout-deterministic."""
+    h = hashlib.sha256()
+    names: list[str] = []
+    f.visit(lambda n: names.append(n))
+    import h5py
+
+    for name in sorted(names):
+        obj = f[name]
+        if not isinstance(obj, h5py.Dataset):
+            continue
+        a = np.ascontiguousarray(obj[...])
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def save_state(
@@ -26,47 +70,129 @@ def save_state(
     band_energies: np.ndarray | None = None,
     band_occupancies: np.ndarray | None = None,
     paw_dm: np.ndarray | None = None,
+    scf_state: dict | None = None,
 ) -> None:
+    """scf_state: optional mid-SCF resume payload (run_scf autosave):
+    scalar entries become /scf attrs, array entries /scf datasets."""
     import h5py
 
-    with h5py.File(path, "w") as f:
-        meta = f.create_group("meta")
-        meta.create_dataset("millers", data=ctx.gvec.millers)
-        meta.create_dataset("lattice", data=ctx.unit_cell.lattice)
-        meta.attrs["num_gvec"] = ctx.gvec.num_gvec
-        meta.attrs["pw_cutoff"] = float(ctx.cfg.parameters.pw_cutoff)
-        meta.attrs["gk_cutoff"] = float(ctx.cfg.parameters.gk_cutoff)
-        # per-k G+k sphere indices: lets load_state remap wave functions
-        # onto a slightly different G-set (restart across small lattice
-        # changes — variable-cell relaxation, stress FD seeding)
-        meta.create_dataset("gk_millers", data=ctx.gkvec.millers)
-        meta.create_dataset("num_gk", data=np.asarray(ctx.gkvec.num_gk))
-        meta.create_dataset("kpoints", data=np.asarray(ctx.gkvec.kpoints))
-        den = f.create_group("density")
-        den.create_dataset("rho_g", data=np.asarray(rho_g))
-        if mag_g is not None:
-            den.create_dataset("mag_g", data=np.asarray(mag_g))
-        if paw_dm is not None:
-            den.create_dataset("paw_dm", data=np.asarray(paw_dm))
-        if veff_g is not None:
-            pot = f.create_group("potential")
-            pot.create_dataset("veff_g", data=np.asarray(veff_g))
-            if bz_g is not None:
-                pot.create_dataset("bz_g", data=np.asarray(bz_g))
-        if psi is not None:
-            ks = f.create_group("kset")
-            ks.create_dataset("psi", data=np.asarray(psi))
-            if band_energies is not None:
-                ks.create_dataset("band_energies", data=np.asarray(band_energies))
-            if band_occupancies is not None:
-                ks.create_dataset("band_occupancies", data=np.asarray(band_occupancies))
+    from sirius_tpu.utils import faults
+
+    # atomic write: temp file in the SAME directory (os.replace must not
+    # cross filesystems), fsync'd, then renamed over the target. A kill at
+    # any point leaves either the old snapshot or the new one — never a
+    # truncated file (reference robustness requirement for restartable
+    # ground states; preemption-safety for long TPU jobs).
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with h5py.File(tmp, "w") as f:
+            meta = f.create_group("meta")
+            meta.create_dataset("millers", data=ctx.gvec.millers)
+            meta.create_dataset("lattice", data=ctx.unit_cell.lattice)
+            meta.attrs["num_gvec"] = ctx.gvec.num_gvec
+            meta.attrs["pw_cutoff"] = float(ctx.cfg.parameters.pw_cutoff)
+            meta.attrs["gk_cutoff"] = float(ctx.cfg.parameters.gk_cutoff)
+            meta.attrs["version"] = SCHEMA_VERSION
+            # per-k G+k sphere indices: lets load_state remap wave functions
+            # onto a slightly different G-set (restart across small lattice
+            # changes — variable-cell relaxation, stress FD seeding)
+            meta.create_dataset("gk_millers", data=ctx.gkvec.millers)
+            meta.create_dataset("num_gk", data=np.asarray(ctx.gkvec.num_gk))
+            meta.create_dataset("kpoints", data=np.asarray(ctx.gkvec.kpoints))
+            den = f.create_group("density")
+            den.create_dataset("rho_g", data=np.asarray(rho_g))
+            if mag_g is not None:
+                den.create_dataset("mag_g", data=np.asarray(mag_g))
+            if paw_dm is not None:
+                den.create_dataset("paw_dm", data=np.asarray(paw_dm))
+            if veff_g is not None:
+                pot = f.create_group("potential")
+                pot.create_dataset("veff_g", data=np.asarray(veff_g))
+                if bz_g is not None:
+                    pot.create_dataset("bz_g", data=np.asarray(bz_g))
+            if psi is not None:
+                ks = f.create_group("kset")
+                ks.create_dataset("psi", data=np.asarray(psi))
+                if band_energies is not None:
+                    ks.create_dataset(
+                        "band_energies", data=np.asarray(band_energies)
+                    )
+                if band_occupancies is not None:
+                    ks.create_dataset(
+                        "band_occupancies", data=np.asarray(band_occupancies)
+                    )
+            if scf_state is not None:
+                sg = f.create_group("scf")
+                for k, v in scf_state.items():
+                    if v is None:
+                        continue
+                    a = np.asarray(v)
+                    if a.ndim == 0:
+                        # numpy unicode scalars (e.g. the mixer kind) have
+                        # no native HDF5 type; store as plain python str so
+                        # h5py writes a variable-length utf-8 attr
+                        sg.attrs[k] = str(a[()]) if a.dtype.kind == "U" else a[()]
+                    else:
+                        sg.create_dataset(k, data=a)
+            meta.attrs["sha256"] = _content_digest(f)
+        # simulate preemption between the durable temp write and the
+        # rename: the previous snapshot at `path` must remain loadable
+        faults.check("checkpoint.before_rename")
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
-def load_state(path: str, ctx) -> dict:
+def load_state(path: str, ctx, verify_checksum: bool = True) -> dict:
     import h5py
 
+    if not os.path.exists(path):
+        raise CheckpointError(f"checkpoint file does not exist: {path}")
     out: dict = {}
-    with h5py.File(path, "r") as f:
+    try:
+        f = h5py.File(path, "r")
+    except OSError as e:
+        raise CheckpointError(
+            f"checkpoint unreadable (truncated or not HDF5): {path}: {e}"
+        ) from e
+    with f:
+        if "meta" not in f:
+            raise CheckpointError(
+                "checkpoint validation failed on field 'meta': group missing "
+                f"in {path}"
+            )
+        version = int(f["meta"].attrs.get("version", 1))
+        if version > SCHEMA_VERSION:
+            raise CheckpointError(
+                "checkpoint validation failed on field 'version': file has "
+                f"schema v{version}, this build reads up to "
+                f"v{SCHEMA_VERSION}"
+            )
+        if verify_checksum and "sha256" in f["meta"].attrs:
+            want = str(f["meta"].attrs["sha256"])
+            got = _content_digest(f)
+            if got != want:
+                raise CheckpointError(
+                    "checkpoint validation failed on field 'sha256': "
+                    f"content digest {got[:12]}… != recorded {want[:12]}… "
+                    "(file corrupt or modified)"
+                )
+        if "millers" not in f["meta"] or "lattice" not in f["meta"]:
+            missing = "millers" if "millers" not in f["meta"] else "lattice"
+            raise CheckpointError(
+                f"checkpoint validation failed on field '{missing}': dataset "
+                "missing from /meta"
+            )
         mill = f["meta/millers"][...]
         exact = mill.shape == ctx.gvec.millers.shape and np.array_equal(
             mill, ctx.gvec.millers
@@ -84,7 +210,10 @@ def load_state(path: str, ctx) -> dict:
                 np.abs(f["meta/lattice"][...] - ctx.unit_cell.lattice).max()
                 > 0.05 * lat_scale
             ):
-                raise ValueError("checkpoint lattice does not match")
+                raise CheckpointError(
+                    "checkpoint validation failed on field 'lattice': saved "
+                    "lattice differs from the current cell by more than 5%"
+                )
         elif not exact:
             # remap by Miller index: restart across a small lattice change
             # (variable-cell relaxation step, strained-lattice seeding);
@@ -100,14 +229,20 @@ def load_state(path: str, ctx) -> dict:
                 and float(f["meta"].attrs["gk_cutoff"])
                 == float(ctx.cfg.parameters.gk_cutoff)
             )
+            if not cut_ok:
+                raise CheckpointError(
+                    "checkpoint validation failed on field 'millers': saved "
+                    "G set was built with different pw_cutoff/gk_cutoff than "
+                    "the current context"
+                )
             if (
-                not cut_ok
-                or np.abs(lat_saved - ctx.unit_cell.lattice).max()
+                np.abs(lat_saved - ctx.unit_cell.lattice).max()
                 > 0.05 * lat_scale
             ):
-                raise ValueError(
-                    "checkpoint G-set does not match the current context "
-                    "(different cutoff or a large lattice change)"
+                raise CheckpointError(
+                    "checkpoint validation failed on field 'lattice': saved "
+                    "G set cannot be remapped across a lattice change "
+                    "larger than 5%"
                 )
             saved = {tuple(m): i for i, m in enumerate(mill)}
             g_map = np.array(
@@ -149,6 +284,11 @@ def load_state(path: str, ctx) -> dict:
             o[..., ok] = a[..., g_map[ok]]
             return o
 
+        if "density" not in f or "rho_g" not in f["density"]:
+            raise CheckpointError(
+                "checkpoint validation failed on field 'density/rho_g': "
+                "dataset missing"
+            )
         out["rho_g"] = remap_g(f["density/rho_g"][...])
         if "mag_g" in f["density"]:
             out["mag_g"] = remap_g(f["density/mag_g"][...])
@@ -172,4 +312,17 @@ def load_state(path: str, ctx) -> dict:
             for k in ("band_energies", "band_occupancies"):
                 if k in f["kset"]:
                     out[k] = f["kset"][k][...]
+        if "scf" in f:
+            # mid-SCF state rides the exact G enumeration it was saved
+            # with: a remapped (strained) restart invalidates the packed
+            # mixer vector/history, so it is only returned on exact match
+            if g_map is None:
+                sg = f["scf"]
+                scf: dict = {
+                    k: v.decode() if isinstance(v, bytes) else v
+                    for k, v in sg.attrs.items()
+                }
+                for k in sg:
+                    scf[k] = sg[k][...]
+                out["scf"] = scf
     return out

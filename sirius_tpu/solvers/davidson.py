@@ -45,6 +45,21 @@ def num_applies(num_steps: int, nb: int, refresh_every: int = REFRESH_EVERY) -> 
     return nb * (num_steps + 2 * nchunks)
 
 
+def residual_health(rnorm, blowup: float = 1e2) -> tuple[float, bool]:
+    """(max residual norm, healthy?) of a band solve's exit residuals —
+    the band-solve sentinel of the SCF supervisor (dft/recovery.py). A
+    non-finite or blown-up residual means the solver stagnated or the
+    subspace collapsed; the supervisor then retries with a deeper subspace
+    or falls back to dense diagonalization."""
+    import numpy as np
+
+    r = np.asarray(rnorm, dtype=np.float64)
+    if r.size == 0:
+        return 0.0, True
+    rmax = float(np.max(r)) if np.all(np.isfinite(r)) else float("inf")
+    return rmax, np.isfinite(rmax) and rmax <= blowup
+
+
 def _rayleigh_ritz(hsub: jax.Array, ssub: jax.Array, nev: int, big: float = 1e6):
     """Lowest-nev gen-EVP of a possibly rank-deficient subspace pair."""
     s, u = jnp.linalg.eigh(ssub)

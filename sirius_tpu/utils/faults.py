@@ -1,0 +1,159 @@
+"""Deterministic fault injection for the SCF supervision/recovery machinery
+(dft/recovery.py).
+
+Every recovery branch — mixer-history flush, beta backoff, host fallback,
+checkpoint-interrupted-save, resume-after-kill — must be drivable from a
+test without waiting for a real divergence or a real preemption. A fault
+plan arms named sites at specific iterations; the instrumented code calls
+the hooks below, which are no-ops when nothing is armed (the common case:
+one dict lookup against an empty plan).
+
+Sites currently wired:
+  scf.density        corrupt the freshly accumulated density (host or fused)
+  scf.potential      corrupt the generated effective potential
+  scf.evals          corrupt the band-solve eigenvalues
+  scf.band_stagnate  force the band-solve health check to report stagnation
+  scf.autosave_kill  die (SimulatedKill or hard exit) right after an autosave
+  checkpoint.before_rename  die inside save_state between the temp-file
+                            write and the atomic rename
+
+Plans are process-local (``install``/``clear``) or inherited by child
+processes through the ``SIRIUS_TPU_FAULTS`` environment variable, e.g.::
+
+    SIRIUS_TPU_FAULTS="scf.density@3:nan,scf.autosave_kill@5:exit"
+
+Each armed entry fires ``count`` times (default once) and then disarms, so
+an injected NaN does not re-poison the state the supervisor just rolled
+back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+ACTIONS = ("nan", "raise", "exit", "flag")
+
+
+class SimulatedKill(Exception):
+    """In-process stand-in for SIGKILL/preemption (raised by 'raise' faults)."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    iteration: int = 0  # SCF iteration (0-based) at which the fault arms
+    action: str = "nan"
+    count: int = 1  # how many times the fault fires before disarming
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action '{self.action}' (known: {ACTIONS})"
+            )
+
+
+_plan: list[FaultSpec] = []
+_log: list[tuple[str, int, str]] = []  # (site, iteration, action) fired
+
+
+def install(specs) -> None:
+    """Arm a fault plan for this process (list of FaultSpec or of
+    (site, iteration[, action[, count]]) tuples)."""
+    global _plan
+    out = []
+    for s in specs:
+        if isinstance(s, FaultSpec):
+            out.append(s)
+        else:
+            out.append(FaultSpec(*s))
+    _plan = out
+    _log.clear()
+
+
+def clear() -> None:
+    global _plan
+    _plan = []
+    _log.clear()
+
+
+def fired() -> list[tuple[str, int, str]]:
+    """(site, iteration, action) records of every fault that fired."""
+    return list(_log)
+
+
+def load_env(env: str | None = None) -> None:
+    """Parse SIRIUS_TPU_FAULTS ('site@iter:action[,...]') into the plan."""
+    env = env if env is not None else os.environ.get("SIRIUS_TPU_FAULTS", "")
+    specs = []
+    for tok in filter(None, (t.strip() for t in env.split(","))):
+        site, _, rest = tok.partition("@")
+        itspec, _, action = rest.partition(":")
+        specs.append(FaultSpec(site, int(itspec or 0), action or "nan"))
+    install(specs)
+
+
+def _match(site: str, iteration: int) -> FaultSpec | None:
+    for s in _plan:
+        if s.site == site and s.iteration == iteration and s.count > 0:
+            return s
+    return None
+
+
+def _consume(spec: FaultSpec, iteration: int) -> str:
+    spec.count -= 1
+    _log.append((spec.site, iteration, spec.action))
+    return spec.action
+
+
+def armed(site: str, iteration: int = 0) -> bool:
+    """True (and consumes one shot) when a 'flag' fault is armed here.
+    Used for sites that alter control flow rather than data, e.g.
+    scf.band_stagnate forcing the band-health check to fail."""
+    spec = _match(site, iteration)
+    if spec is None:
+        return False
+    _consume(spec, iteration)
+    return True
+
+
+def check(site: str, iteration: int = 0) -> None:
+    """Fire a kill-style fault: 'raise' -> SimulatedKill, 'exit' -> hard
+    process exit with no cleanup (the closest in-process analog of
+    SIGKILL/preemption)."""
+    spec = _match(site, iteration)
+    if spec is None:
+        return
+    action = _consume(spec, iteration)
+    if action == "raise":
+        raise SimulatedKill(f"fault '{site}' at iteration {iteration}")
+    if action == "exit":
+        os._exit(137)
+    # nan/flag actions are meaningless here; treat as armed-and-ignored
+
+
+def corrupt(site: str, iteration: int, arr):
+    """Return `arr` with a NaN injected in its first element when a 'nan'
+    fault is armed at (site, iteration); otherwise `arr` unchanged. Works
+    for numpy arrays and jax arrays (functional .at update)."""
+    spec = _match(site, iteration)
+    if spec is None:
+        return arr
+    action = _consume(spec, iteration)
+    if action != "nan":
+        if action == "raise":
+            raise SimulatedKill(f"fault '{site}' at iteration {iteration}")
+        if action == "exit":
+            os._exit(137)
+        return arr
+    if isinstance(arr, np.ndarray):
+        out = arr.copy()
+        out.reshape(-1)[0] = np.nan
+        return out
+    # jax array: functional update (stays on device; NaN propagates through
+    # the fused program exactly like a real numerical blow-up would)
+    flat = arr.reshape(-1)
+    flat = flat.at[0].set(np.nan)
+    return flat.reshape(arr.shape)

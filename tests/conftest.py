@@ -29,6 +29,24 @@ except AttributeError:
         os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_enable_x64", True)
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy decks / long SCF runs (deselect with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection tests for the SCF recovery ladder")
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    """Fault plans must never leak between tests (utils/faults.py keeps
+    module-level state)."""
+    from sirius_tpu.utils import faults
+
+    faults.clear()
+    yield
+    faults.clear()
+
+
 REFERENCE_ROOT = "/root/reference"
 
 

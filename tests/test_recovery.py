@@ -1,0 +1,179 @@
+"""SCF supervision & recovery (dft/recovery.py): every rung of the backoff
+ladder driven by fault injection (utils/faults.py) — mixer-history flush,
+beta backoff to linear mixing, host fallback from the fused path, the
+band-solve rescue, and the structured abort. Each fault corrupts real state
+mid-run; the assertion is always that the supervised run still converges to
+the unperturbed energy."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sirius_tpu.dft.recovery import LADDER, ScfAbortError, ScfSupervisor
+from sirius_tpu.testing import synthetic_silicon_context
+from sirius_tpu.utils import faults
+
+pytestmark = pytest.mark.faults
+
+# tiny deck: 1 k-point, 8 bands, converges in ~12 host iterations
+DECK = dict(
+    gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+    ultrasoft=True, use_symmetry=False,
+    extra_params={"num_dft_iter": 40, "density_tol": 5e-9,
+                  "energy_tol": 1e-10},
+)
+
+
+def _run(device_scf="off", plan=None, serial_bands=False, deck=None, **ctl):
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx = synthetic_silicon_context(**(deck or DECK))
+    ctx.cfg.control.device_scf = device_scf
+    for k, v in ctl.items():
+        setattr(ctx.cfg.control, k, v)
+    faults.install(plan or [])
+    return run_scf(ctx.cfg, ctx=ctx, serial_bands=serial_bands)
+
+
+@pytest.fixture(scope="module")
+def e_ref():
+    """Unperturbed host-path total energy of the shared deck."""
+    r = _run("off")
+    assert r["converged"]
+    assert r["recovery"]["recoveries"] == 0
+    return r["energy"]["total"]
+
+
+def test_nan_density_recovers_host(e_ref):
+    """A NaN injected into the accumulated density at iteration 3 must not
+    raise: the supervisor rolls back, flushes the mixer history, and the
+    run converges to the unperturbed energy (ISSUE acceptance bar)."""
+    r = _run("off", plan=[("scf.density", 3, "nan")])
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 1
+    assert rec["ladder_history"][0]["action"] == "flush_history"
+    assert rec["ladder_history"][0]["sentinel"] == "nonfinite_fields"
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_nan_density_recovers_fused(e_ref):
+    """Same fault on the device-resident path: the fused step's all-finite
+    scalar sentinel detects it without extra host traffic, the carry is
+    re-seeded from the snapshot, and the run converges."""
+    r = _run("auto", plan=[("scf.density", 3, "nan")])
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 1
+    assert rec["ladder_history"][0]["sentinel"] == "device_nonfinite"
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_nan_potential_recovers_host(e_ref):
+    r = _run("off", plan=[("scf.potential", 2, "nan")])
+    assert r["converged"]
+    assert r["recovery"]["ladder_history"][0]["sentinel"] == (
+        "potential_nonfinite")
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_nan_evals_recovers_host(e_ref):
+    r = _run("off", plan=[("scf.evals", 2, "nan")])
+    assert r["converged"]
+    assert r["recovery"]["recoveries"] == 1
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_ladder_escalates_to_host_fallback(e_ref):
+    """Three injected divergences escalate rung by rung: history flush ->
+    halved beta + linear mixing -> fused path disabled (host fallback).
+    The run must still converge to the unperturbed energy.
+
+    Needs a larger iteration budget than the other tests: after the third
+    recovery the run finishes on the host with halved-beta linear mixing,
+    whose error decays only ~0.66x per iteration from the rollback point."""
+    deck = dict(DECK)
+    deck["extra_params"] = dict(DECK["extra_params"], num_dft_iter=120)
+    r = _run("auto", deck=deck, plan=[
+        ("scf.density", 4, "nan"),
+        ("scf.density", 7, "nan"),
+        ("scf.density", 10, "nan"),
+    ])
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 3
+    assert [h["action"] for h in rec["ladder_history"]] == list(LADDER[:3])
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_abort_carries_diagnostic(tmp_path):
+    """With the recovery budget exhausted the supervisor aborts with a
+    structured diagnostic (and dumps it as JSON when configured) instead
+    of a bare FloatingPointError."""
+    dump = tmp_path / "diag.json"
+    with pytest.raises(ScfAbortError) as ei:
+        _run("off", plan=[("scf.density", 2, "nan"),
+                          ("scf.density", 4, "nan")],
+             max_recoveries=1, diag_dump=str(dump))
+    diag = ei.value.diagnostic
+    assert diag["sentinel"] == "nonfinite_fields"
+    assert diag["recoveries"] == 1
+    assert diag["last_good_iteration"] is not None
+    # ScfAbortError subclasses FloatingPointError: pre-existing callers of
+    # the old fatal behaviour keep catching it
+    assert isinstance(ei.value, FloatingPointError)
+    on_disk = json.loads(dump.read_text())
+    assert on_disk["sentinel"] == "nonfinite_fields"
+    assert on_disk["ladder_history"]
+
+
+def test_supervision_off_restores_fatal_behaviour():
+    """control.scf_supervision = False keeps the historical contract: the
+    first non-finite field raises."""
+    with pytest.raises(FloatingPointError):
+        _run("off", plan=[("scf.density", 2, "nan")], scf_supervision=False)
+
+
+def test_band_stagnate_deep_retry(e_ref):
+    """A flagged band-solve stagnation on the batched host path triggers
+    one deeper-subspace Davidson retry; the run converges normally."""
+    r = _run("off", plan=[("scf.band_stagnate", 2, "flag")])
+    assert ("scf.band_stagnate", 2, "flag") in faults.fired()
+    assert r["converged"]
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_band_stagnate_exact_diag_fallback(e_ref):
+    """On the serial path with a small |G+k| sphere the rescue is a dense
+    exact diagonalization (solvers/eigen.py) — the strongest fallback."""
+    r = _run("off", plan=[("scf.band_stagnate", 2, "flag")],
+             serial_bands=True)
+    assert ("scf.band_stagnate", 2, "flag") in faults.fired()
+    assert r["converged"]
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_rms_divergence_sentinel_unit():
+    """ScfSupervisor.observe fires rms_divergence only on a sustained,
+    order-of-magnitude RMS growth — plain non-monotone Anderson steps must
+    not trip it."""
+
+    class Ctl:
+        scf_supervision = True
+        max_recoveries = 3
+        rms_divergence_iters = 4
+        energy_blowup_tol = 1e4
+        diag_dump = ""
+
+    sup = ScfSupervisor(Ctl(), 0.7, "anderson")
+    # non-monotone but bounded: never fires
+    for it, rms in enumerate([1e-3, 2e-3, 1.5e-3, 2.5e-3, 2e-3, 3e-3]):
+        assert sup.observe(it, rms, -1.0) is None
+    # sustained exponential growth: fires after 4 growing iterations
+    fired = [sup.observe(10 + i, 1e-3 * 4.0 ** i, -1.0) for i in range(5)]
+    assert "rms_divergence" in fired
+    # energy blow-up
+    sup2 = ScfSupervisor(Ctl(), 0.7, "anderson")
+    assert sup2.observe(0, 1e-3, -1.0) is None
+    assert sup2.observe(1, 1e-3, 2e4) == "energy_blowup"

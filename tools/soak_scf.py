@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Preemption soak test for SCF autosave/resume (ISSUE: robustness PR).
+
+Repeatedly hard-kills a child SCF run at pseudo-random iterations (the
+child dies with os._exit(137) right after an autosave — the in-process
+analog of SIGKILL/preemption, armed through SIRIUS_TPU_FAULTS) and then
+resumes it from the autosave. Every cycle must end with the resumed run
+converging to the reference energy of an uninterrupted run.
+
+Usage:
+    python tools/soak_scf.py [--kills N] [--seed S] [--device-scf auto|off]
+                             [--tol 1e-8] [--workdir DIR]
+
+Exit status 0 = every resume converged to the reference energy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# tiny deck: 1 k-point, 8 bands, ~12 host iterations to convergence
+DECK = dict(
+    gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+    ultrasoft=True, use_symmetry=False,
+    extra_params={"num_dft_iter": 40, "density_tol": 5e-9,
+                  "energy_tol": 1e-10},
+)
+
+
+def child_main(args: argparse.Namespace) -> int:
+    """Run one SCF (optionally resuming from the autosave) and print the
+    result as a single JSON line. The kill fault, when armed via
+    SIRIUS_TPU_FAULTS, fires inside run_scf right after an autosave."""
+    sys.path.insert(0, REPO)
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(**DECK)
+    ctx.cfg.control.device_scf = args.device_scf
+    ctx.cfg.control.autosave_every = 1
+    ctx.cfg.control.autosave_path = args.checkpoint
+    resume = args.checkpoint if args.resume else None
+    r = run_scf(ctx.cfg, ctx=ctx, resume=resume)
+    print(json.dumps({
+        "energy": r["energy"]["total"],
+        "converged": r["converged"],
+        "iterations": r["num_scf_iterations"],
+    }), flush=True)
+    return 0
+
+
+def run_child(checkpoint: str, device_scf: str, resume: bool,
+              kill_at: int | None) -> tuple[int, dict | None]:
+    env = dict(os.environ)
+    env.pop("SIRIUS_TPU_FAULTS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if kill_at is not None:
+        env["SIRIUS_TPU_FAULTS"] = f"scf.autosave_kill@{kill_at}:exit"
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--checkpoint", checkpoint, "--device-scf", device_scf]
+    if resume:
+        cmd.append("--resume")
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=1800)
+    payload = None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            payload = json.loads(line)
+    if out.returncode not in (0, 137):
+        sys.stderr.write(out.stdout + out.stderr)
+    return out.returncode, payload
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--kills", type=int, default=5,
+                    help="number of kill+resume cycles (default 5)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--device-scf", default="off", choices=["off", "auto"])
+    ap.add_argument("--tol", type=float, default=1e-8,
+                    help="energy agreement bar vs the uninterrupted run")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--checkpoint", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--resume", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        return child_main(args)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sirius_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    rng = random.Random(args.seed)
+
+    print(f"[soak] workdir={workdir} device_scf={args.device_scf}")
+    ck_ref = os.path.join(workdir, "ref.h5")
+    rc, ref = run_child(ck_ref, args.device_scf, resume=False, kill_at=None)
+    if rc != 0 or ref is None or not ref["converged"]:
+        print("[soak] FAIL: reference run did not converge")
+        return 1
+    print(f"[soak] reference energy {ref['energy']:.12f} "
+          f"({ref['iterations']} iterations)")
+
+    failures = 0
+    for cycle in range(args.kills):
+        ck = os.path.join(workdir, f"cycle{cycle}.h5")
+        if os.path.exists(ck):
+            os.remove(ck)
+        kill_at = rng.randint(2, max(3, ref["iterations"] - 2))
+        rc, _ = run_child(ck, args.device_scf, resume=False, kill_at=kill_at)
+        if rc != 137:
+            print(f"[soak] cycle {cycle}: expected kill (137), got rc={rc}")
+            failures += 1
+            continue
+        # resume; a second kill must not be armed, so this runs to the end
+        rc, res = run_child(ck, args.device_scf, resume=True, kill_at=None)
+        ok = (rc == 0 and res is not None and res["converged"]
+              and abs(res["energy"] - ref["energy"]) <= args.tol)
+        status = "ok" if ok else "FAIL"
+        got = res["energy"] if res else float("nan")
+        print(f"[soak] cycle {cycle}: killed at it={kill_at}, resumed -> "
+              f"{got:.12f} (|dE|={abs(got - ref['energy']):.2e}) {status}")
+        failures += 0 if ok else 1
+
+    print(f"[soak] {args.kills - failures}/{args.kills} cycles passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
