@@ -80,6 +80,17 @@ class ControlConfig:
     # <base_dir>/sirius_autosave.h5); run_scf(resume=path) restarts from it
     autosave_every: int = 0
     autosave_path: str = ""
+    # job-scoped autosave naming: when autosave_tag is set the default
+    # autosave path becomes <base_dir>/sirius_autosave.<tag>.h5 so jobs
+    # sharing a workdir (the serving engine) do not clobber each other
+    autosave_tag: str = ""
+    # keep the last N rotated autosaves (path, path.1, ... path.N-1);
+    # 0 keeps the historical single-file overwrite behaviour
+    autosave_keep: int = 0
+    # pad every k-point's |G+k| sphere up to a multiple of this quantum
+    # (0 = exact ngk_max). Serving uses it to coalesce decks whose spheres
+    # differ slightly into one executable-shape bucket.
+    ngk_pad_quantum: int = 0
     # on abort, dump the supervisor diagnostic (sentinel, iteration,
     # last-good energies, ladder history) as JSON to this path ("" = off)
     diag_dump: str = ""

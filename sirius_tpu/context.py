@@ -93,6 +93,9 @@ class SimulationContext:
         c2f = gvec.index_of_millers(gvec_coarse.millers)
         assert np.all(c2f >= 0)
         gkvec = GkVec.build(gvec, kpts, p.gk_cutoff, fft_coarse, weights=kw)
+        quantum = int(getattr(cfg.control, "ngk_pad_quantum", 0) or 0)
+        if quantum > 0:
+            gkvec = gkvec.pad_to(-(-gkvec.ngk_max // quantum) * quantum)
 
         beta = BetaProjectors.build(uc, gkvec, qmax=p.gk_cutoff + 1e-9)
         aug = None

@@ -220,6 +220,32 @@ class GkVec:
     def ngk_max(self) -> int:
         return self.millers.shape[1]
 
+    def pad_to(self, ngk: int) -> "GkVec":
+        """Widen every sphere to ``ngk`` columns (mask=0 padding).
+
+        Padding columns behave exactly like the existing ragged-sphere
+        padding (zero millers/gkcart, fft_index 0, kinetic() -> 1e4), so
+        the result is valid for every solver path. Used by the serving
+        engine to round ngk_max up to a shape quantum so near-identical
+        decks share compiled executables.
+        """
+        cur = self.ngk_max
+        if ngk <= cur:
+            return self
+        nk = self.num_kpoints
+        extra = ngk - cur
+        pad3 = lambda a: np.concatenate(  # noqa: E731
+            [a, np.zeros((nk, extra, 3), dtype=a.dtype)], axis=1)
+        pad2 = lambda a: np.concatenate(  # noqa: E731
+            [a, np.zeros((nk, extra), dtype=a.dtype)], axis=1)
+        return dataclasses.replace(
+            self,
+            millers=pad3(self.millers),
+            gkcart=pad3(self.gkcart),
+            mask=pad2(self.mask),
+            fft_index=pad2(self.fft_index),
+        )
+
     def kinetic(self) -> np.ndarray:
         """|G+k|^2 / 2 per (k, g); padded slots get a large value so they stay
         out of the low eigenspace in padded diagonalizations."""

@@ -108,7 +108,7 @@ class FusedScf:
     """
 
     def __init__(self, ctx, xc, mixer, polarized: bool, do_symmetrize: bool,
-                 beta_dev=None):
+                 beta_dev=None, exec_cache=None):
         self.ctx = ctx
         self.xc = xc
         self.polarized = bool(polarized)
@@ -160,7 +160,38 @@ class FusedScf:
         # program inputs, not baked-in constants
         self.tables = jax.tree_util.tree_map(jnp.asarray, tables)
         self.kweights_dev = jnp.asarray(np.asarray(ctx.kweights))
-        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        if exec_cache is not None:
+            # serving: reuse a previously-jitted step whose trace signature
+            # matches. The jitted callable is a bound method of the FIRST
+            # instance in the bucket; every trace constant it bakes in is
+            # part of the signature, and the tables it operates on are
+            # program inputs, so reuse is exact — padded decks in one shape
+            # bucket skip XLA compilation entirely.
+            self._step = exec_cache.get(
+                ("fused_step", *self._trace_signature()),
+                lambda: jax.jit(self._step_impl, donate_argnums=(1,)),
+            )
+        else:
+            self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    def _trace_signature(self) -> tuple:
+        """Everything _step_impl bakes into its trace (instance attrs used
+        inside the jitted body) plus the shapes/dtypes of its table inputs
+        and the per-call array ranks (nk/nb/ngk). Two FusedScf instances
+        with equal signatures compile to identical programs."""
+        leaves, treedef = jax.tree_util.tree_flatten(self.tables)
+        tab = tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
+        return (
+            self.ns, self.ng, self.nx, self.omega,
+            self.dims, self.dims_coarse,
+            self.kind, self.mix_beta, self.max_history,
+            self.has_aug, self.do_symmetrize, self.polarized,
+            tuple(self.xc.names),
+            self.ctx.gkvec.num_kpoints, self.ctx.num_bands,
+            self.ctx.gkvec.ngk_max,
+            str(treedef), tab,
+            tuple(self.kweights_dev.shape),
+        )
 
     # -- host <-> device edges -------------------------------------------
 

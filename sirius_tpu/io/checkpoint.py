@@ -71,9 +71,14 @@ def save_state(
     band_occupancies: np.ndarray | None = None,
     paw_dm: np.ndarray | None = None,
     scf_state: dict | None = None,
+    rotate_keep: int = 0,
 ) -> None:
     """scf_state: optional mid-SCF resume payload (run_scf autosave):
-    scalar entries become /scf attrs, array entries /scf datasets."""
+    scalar entries become /scf attrs, array entries /scf datasets.
+
+    rotate_keep: keep the last N snapshots by shifting path -> path.1 ->
+    ... -> path.(N-1) (logrotate style) before the atomic rename; 0 keeps
+    the historical single-file overwrite."""
     import h5py
 
     from sirius_tpu.utils import faults
@@ -144,6 +149,20 @@ def save_state(
             os.fsync(fd)
         finally:
             os.close(fd)
+        if rotate_keep > 0 and os.path.exists(path):
+            # shift the existing generations up; each step is itself an
+            # atomic rename, so a kill mid-rotation loses at most the
+            # oldest generation, never the newest
+            if os.path.exists(f"{path}.{rotate_keep - 1}"):
+                try:
+                    os.remove(f"{path}.{rotate_keep - 1}")
+                except OSError:
+                    pass
+            for i in range(rotate_keep - 1, 1, -1):
+                if os.path.exists(f"{path}.{i - 1}"):
+                    os.replace(f"{path}.{i - 1}", f"{path}.{i}")
+            if rotate_keep > 1:
+                os.replace(path, f"{path}.1")
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -326,3 +345,44 @@ def load_state(path: str, ctx, verify_checksum: bool = True) -> dict:
                     scf[k] = sg[k][...]
                 out["scf"] = scf
     return out
+
+
+def validate_checkpoint(path: str) -> bool:
+    """Cheap context-free validity probe: file opens as HDF5, has /meta,
+    readable schema version, and (when recorded) an intact sha256 digest.
+    Used by the serving engine / restart task to pick a resume candidate
+    without building a SimulationContext first."""
+    import h5py
+
+    if not os.path.exists(path):
+        return False
+    try:
+        with h5py.File(path, "r") as f:
+            if "meta" not in f:
+                return False
+            if int(f["meta"].attrs.get("version", 1)) > SCHEMA_VERSION:
+                return False
+            if "sha256" in f["meta"].attrs:
+                if _content_digest(f) != str(f["meta"].attrs["sha256"]):
+                    return False
+    except OSError:
+        return False
+    return True
+
+
+def find_resumable(path: str, keep: int = 0) -> str | None:
+    """Newest valid snapshot in the rotation ``path, path.1, ...``.
+
+    Returns None when no generation validates (fresh start). ``keep``
+    bounds the generations probed beyond any that exist on disk."""
+    candidates = [path] + [f"{path}.{i}" for i in range(1, max(keep, 1))]
+    for p in candidates:
+        if validate_checkpoint(p):
+            return p
+    # probe a few extra generations in case keep was lowered between runs
+    i = max(keep, 1)
+    while os.path.exists(f"{path}.{i}") and i < 100:
+        if validate_checkpoint(f"{path}.{i}"):
+            return f"{path}.{i}"
+        i += 1
+    return None

@@ -19,8 +19,38 @@ import xml.etree.ElementTree as ET
 import numpy as np
 
 
-def _floats(el) -> list:
-    return [float(x) for x in el.text.split()]
+class UpfParseError(ValueError):
+    """Typed parse failure naming the offending UPF field.
+
+    Raised for truncated/malformed files so callers (the serving engine in
+    particular) can classify the job as permanently failed instead of
+    crashing mid-SCF on a bare AttributeError/ValueError. ``field`` is the
+    UPF element or attribute that was missing or unparseable.
+    """
+
+    def __init__(self, path: str, field: str, detail: str):
+        self.path = path
+        self.field = field
+        self.detail = detail
+        super().__init__(f"{path}: UPF parse error in '{field}': {detail}")
+
+
+def _require(root, tag: str, path: str):
+    el = root.find(tag)
+    if el is None:
+        raise UpfParseError(path, tag, "required element missing")
+    return el
+
+
+def _floats(el, field: str = "?", path: str = "?") -> list:
+    if el is None:
+        raise UpfParseError(path, field, "required element missing")
+    if el.text is None:
+        raise UpfParseError(path, field, "element has no numeric data")
+    try:
+        return [float(x) for x in el.text.split()]
+    except ValueError as e:
+        raise UpfParseError(path, field, f"non-numeric data: {e}") from None
 
 
 def _attrib(el, name, default=None):
@@ -32,20 +62,40 @@ def _bool(v) -> bool:
     return str(v).strip().upper() in ("T", "TRUE", ".TRUE.", "1")
 
 
+def _header_field(h: dict, name: str, conv, path: str):
+    if name not in h:
+        raise UpfParseError(path, f"PP_HEADER/{name}",
+                            "required attribute missing")
+    try:
+        return conv(h[name])
+    except ValueError as e:
+        raise UpfParseError(path, f"PP_HEADER/{name}",
+                            f"unparseable value {h[name]!r}: {e}") from None
+
+
 def upf2_to_json(path: str) -> dict:
-    """Parse a UPF v2 file into the SIRIUS pseudo_potential JSON layout."""
-    root = ET.parse(path).getroot()
+    """Parse a UPF v2 file into the SIRIUS pseudo_potential JSON layout.
+
+    Raises UpfParseError (a ValueError subclass) on truncated or malformed
+    input, naming the offending element/attribute.
+    """
+    try:
+        root = ET.parse(path).getroot()
+    except ET.ParseError as e:
+        raise UpfParseError(path, "XML", f"malformed/truncated XML: {e}") \
+            from None
     if root.tag != "UPF":
-        raise ValueError(f"{path}: not a UPF v2 file (root tag {root.tag})")
-    h = root.find("PP_HEADER").attrib
+        raise UpfParseError(path, "UPF",
+                            f"not a UPF v2 file (root tag {root.tag})")
+    h = _require(root, "PP_HEADER", path).attrib
 
     pp: dict = {}
     header = {
-        "element": h["element"].strip(),
-        "pseudo_type": h["pseudo_type"].strip(),
+        "element": _header_field(h, "element", str, path).strip(),
+        "pseudo_type": _header_field(h, "pseudo_type", str, path).strip(),
         "core_correction": _bool(h.get("core_correction", "F")),
-        "z_valence": float(h["z_valence"]),
-        "mesh_size": int(h["mesh_size"]),
+        "z_valence": _header_field(h, "z_valence", float, path),
+        "mesh_size": _header_field(h, "mesh_size", int, path),
         "number_of_wfc": int(h.get("number_of_wfc", 0)),
         "number_of_proj": int(h.get("number_of_proj", 0)),
         "is_ultrasoft": _bool(h.get("is_ultrasoft", "F")),
@@ -53,32 +103,42 @@ def upf2_to_json(path: str) -> dict:
         "original_upf_file": path.rsplit("/", 1)[-1],
     }
 
-    r = np.asarray(_floats(root.find("PP_MESH/PP_R")))
+    r = np.asarray(_floats(root.find("PP_MESH/PP_R"), "PP_MESH/PP_R", path))
     pp["radial_grid"] = r.tolist()
     vloc = root.find("PP_LOCAL")
     if vloc is not None:
-        pp["local_potential"] = (0.5 * np.asarray(_floats(vloc))).tolist()
+        pp["local_potential"] = (
+            0.5 * np.asarray(_floats(vloc, "PP_LOCAL", path))
+        ).tolist()
     nlcc = root.find("PP_NLCC")
     if nlcc is not None:
-        pp["core_charge_density"] = _floats(nlcc)
+        pp["core_charge_density"] = _floats(nlcc, "PP_NLCC", path)
     rho = root.find("PP_RHOATOM")
     if rho is not None:
-        pp["total_charge_density"] = _floats(rho)
+        pp["total_charge_density"] = _floats(rho, "PP_RHOATOM", path)
 
     # --- beta projectors (truncated at their cutoff index) ---
-    nl = root.find("PP_NONLOCAL")
-    betas = []
     nproj = header["number_of_proj"]
+    nl = root.find("PP_NONLOCAL")
+    if nl is None and nproj > 0:
+        raise UpfParseError(path, "PP_NONLOCAL",
+                            f"missing but header declares {nproj} projectors")
+    betas = []
     max_cri = 0
     for i in range(1, nproj + 1):
         b = nl.find(f"PP_BETA.{i}")
-        vals = _floats(b)
+        vals = _floats(b, f"PP_NONLOCAL/PP_BETA.{i}", path)
         cri = _attrib(b, "cutoff_radius_index")
         n = int(cri) if cri else len(vals)
         max_cri = max(max_cri, n)
+        l_attr = _attrib(b, "angular_momentum")
+        if l_attr is None:
+            raise UpfParseError(
+                path, f"PP_NONLOCAL/PP_BETA.{i}/angular_momentum",
+                "required attribute missing")
         entry = {
             "radial_function": vals[:n],
-            "angular_momentum": int(_attrib(b, "angular_momentum")),
+            "angular_momentum": int(l_attr),
         }
         lab = _attrib(b, "label")
         if lab:
@@ -88,12 +148,14 @@ def upf2_to_json(path: str) -> dict:
             entry["total_angular_momentum"] = float(j)
         betas.append(entry)
     pp["beta_projectors"] = betas
-    dij = nl.find("PP_DIJ")
+    dij = nl.find("PP_DIJ") if nl is not None else None
     if dij is not None:
-        pp["D_ion"] = (0.5 * np.asarray(_floats(dij))).tolist()
+        pp["D_ion"] = (
+            0.5 * np.asarray(_floats(dij, "PP_NONLOCAL/PP_DIJ", path))
+        ).tolist()
 
     # --- augmentation (US/PAW): Q_ij^l(r) with q_with_l ---
-    aug_el = nl.find("PP_AUGMENTATION")
+    aug_el = nl.find("PP_AUGMENTATION") if nl is not None else None
     if aug_el is not None and _bool(_attrib(aug_el, "q_with_l", "F")):
         aug = []
         ls = [b["angular_momentum"] for b in betas]
@@ -107,7 +169,8 @@ def upf2_to_json(path: str) -> dict:
                         "i": i,
                         "j": j,
                         "angular_momentum": l,
-                        "radial_function": _floats(q),
+                        "radial_function": _floats(
+                            q, f"PP_QIJL.{i + 1}.{j + 1}.{l}", path),
                     })
         pp["augmentation"] = aug
 
@@ -122,7 +185,7 @@ def upf2_to_json(path: str) -> dict:
             # NOTE: the reference converter keeps beta labels but DROPS the
             # chi labels (checked against the shipped .UPF.json files)
             wfs.append({
-                "radial_function": _floats(c),
+                "radial_function": _floats(c, f"PP_CHI.{i}", path),
                 "angular_momentum": int(_attrib(c, "l")),
                 "occupation": float(_attrib(c, "occupation", 0.0)),
             })
@@ -140,14 +203,15 @@ def upf2_to_json(path: str) -> dict:
         pd: dict = {}
         occ = paw_el.find("PP_OCCUPATIONS")
         if occ is not None:
-            pd["occupations"] = _floats(occ)
+            pd["occupations"] = _floats(occ, "PP_PAW/PP_OCCUPATIONS", path)
         ae_nlcc = paw_el.find("PP_AE_NLCC")
         if ae_nlcc is not None:
-            pd["ae_core_charge_density"] = _floats(ae_nlcc)
+            pd["ae_core_charge_density"] = _floats(
+                ae_nlcc, "PP_PAW/PP_AE_NLCC", path)
         ae_vloc = paw_el.find("PP_AE_VLOC")
         if ae_vloc is not None:
             pd["ae_local_potential"] = (
-                0.5 * np.asarray(_floats(ae_vloc))
+                0.5 * np.asarray(_floats(ae_vloc, "PP_PAW/PP_AE_VLOC", path))
             ).tolist()
         if full_wfc is not None:
             ae, ps = [], []
@@ -156,12 +220,12 @@ def upf2_to_json(path: str) -> dict:
                 p_ = full_wfc.find(f"PP_PSWFC.{i}")
                 if a is not None:
                     ae.append({
-                        "radial_function": _floats(a),
+                        "radial_function": _floats(a, f"PP_AEWFC.{i}", path),
                         "angular_momentum": int(_attrib(a, "l")),
                     })
                 if p_ is not None:
                     ps.append({
-                        "radial_function": _floats(p_),
+                        "radial_function": _floats(p_, f"PP_PSWFC.{i}", path),
                         "angular_momentum": int(_attrib(p_, "l")),
                     })
             pd["ae_wfc"] = ae
@@ -170,10 +234,10 @@ def upf2_to_json(path: str) -> dict:
         if aug_el is not None:
             q = aug_el.find("PP_Q")
             if q is not None:
-                pd["aug_integrals"] = _floats(q)
+                pd["aug_integrals"] = _floats(q, "PP_AUGMENTATION/PP_Q", path)
             m = aug_el.find("PP_MULTIPOLES")
             if m is not None:
-                pd["aug_multipoles"] = _floats(m)
+                pd["aug_multipoles"] = _floats(m, "PP_AUGMENTATION/PP_MULTIPOLES", path)
         pp["paw_data"] = pd
 
     pp["header"] = header

@@ -49,7 +49,7 @@ def kset_spec() -> P:
     return P("k", None, "b", None)
 
 
-def production_mesh(nk: int, nb: int):
+def production_mesh(nk: int, nb: int, devices=None):
     """Mesh for the production SCF on however many devices are present.
 
     Chooses (num_k, num_b) with num_k | nk, num_b | nb and
@@ -62,10 +62,14 @@ def production_mesh(nk: int, nb: int):
 
     Multi-process (multi-host) runs require every process's devices in
     the mesh, so partial meshes are limited to single-process sessions;
-    multi-host falls back to the full-device gcd factorization."""
+    multi-host falls back to the full-device gcd factorization.
+
+    devices: explicit device list to build the mesh from (a serving-engine
+    slice); defaults to jax.devices()."""
     import math
 
-    ndev = len(jax.devices())
+    devices = list(devices) if devices is not None else jax.devices()
+    ndev = len(devices)
     if ndev <= 1:
         return None, None
     nk = max(nk, 1)
@@ -73,6 +77,8 @@ def production_mesh(nk: int, nb: int):
     multi_host = jax.process_count() > 1
     if multi_host:
         num_k = math.gcd(nk, ndev)
+        # (multi-host ignores `devices`: every process's devices must be in
+        # the mesh, so slice scheduling is a single-process feature)
         # full-device mesh (multi-host requires every device present); the
         # band axis is sized ndev//num_k and only USED when nb divides it —
         # otherwise the "b" axis replicates (spec None below) by design
@@ -93,7 +99,7 @@ def production_mesh(nk: int, nb: int):
     num_k, num_b = best
     if num_k * num_b == 1:
         return None, None
-    devs = np.array(jax.devices()[: num_k * num_b])
+    devs = np.array(devices[: num_k * num_b])
     mesh = Mesh(devs.reshape(num_k, num_b), ("k", "b"))
     band_ax = "b" if num_b > 1 else None
     return mesh, P("k", None, band_ax, None)

@@ -1,0 +1,23 @@
+"""Multi-job SCF serving: queue + executable cache + device-slice scheduler.
+
+The serving layer amortizes XLA compilation across independent SCF jobs
+(the throughput lever of TPU practice — Lewis et al. arXiv:2112.09017,
+Pederson et al. arXiv:2202.01255): decks whose padded shapes match share
+jitted FusedScf/Davidson executables, and the global device mesh is
+partitioned into slices that each run one job at a time.
+
+Entry points: ServeEngine (library), `sirius-serve` (CLI, serve.engine),
+tools/loadgen.py (throughput/latency benchmark).
+"""
+
+from sirius_tpu.serve.cache import ExecutableCache
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.serve.scheduler import SliceScheduler
+
+__all__ = [
+    "ExecutableCache",
+    "Job",
+    "JobQueue",
+    "JobStatus",
+    "SliceScheduler",
+]

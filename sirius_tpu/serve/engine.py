@@ -1,0 +1,173 @@
+"""ServeEngine: queue + executable cache + slice scheduler, and the
+`sirius-serve` CLI.
+
+Library use::
+
+    eng = ServeEngine(num_slices=4)
+    eng.start()
+    job = eng.submit(deck_dict, priority=1)
+    job.wait()
+    eng.shutdown()
+    print(eng.stats())
+
+CLI use: ``sirius-serve deck1.json deck2.json ... [--slices N]`` runs the
+decks to completion and prints a JSON stats report (the same shape
+tools/loadgen.py writes to SERVE_BENCH.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from sirius_tpu.serve.cache import ExecutableCache
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.serve.scheduler import SliceScheduler
+
+
+def _percentile(xs, q: float) -> float:
+    """Nearest-rank percentile of a non-empty list."""
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[i]
+
+
+class ServeEngine:
+    def __init__(self, num_slices: int = 1, devices=None,
+                 cache_capacity: int = 32, autosave_every: int = 3,
+                 autosave_keep: int = 2, workdir: str = ".",
+                 verbose: bool = False):
+        self.queue = JobQueue()
+        self.cache = ExecutableCache(capacity=cache_capacity)
+        self.workdir = workdir
+        self.scheduler = SliceScheduler(
+            self.queue, self.cache, num_slices=num_slices, devices=devices,
+            autosave_every=autosave_every, autosave_keep=autosave_keep,
+            verbose=verbose,
+        )
+        self._t0: float | None = None
+        self._submitted: list[Job] = []
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.scheduler.slices)
+
+    def start(self) -> None:
+        self._t0 = time.time()
+        self.scheduler.start()
+
+    def submit(self, deck: dict, job_id: str | None = None,
+               priority: int = 0, deadline: float | None = None,
+               base_dir: str | None = None, max_retries: int = 2) -> Job:
+        job = Job(
+            deck, job_id=job_id, base_dir=base_dir or self.workdir,
+            priority=priority, deadline=deadline, max_retries=max_retries,
+        )
+        self._submitted.append(job)
+        return self.queue.submit(job)
+
+    def wait_all(self, timeout: float | None = None) -> bool:
+        """Block until every submitted job is terminal. False on timeout."""
+        deadline = None if timeout is None else time.time() + timeout
+        for job in self._submitted:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return False
+            if not job.wait(remaining):
+                return False
+        return True
+
+    def shutdown(self, wait: bool = True, cleanup: bool = True) -> None:
+        self.queue.close()
+        if wait:
+            self.scheduler.join(timeout=60.0)
+        if cleanup:
+            self.scheduler.cleanup_autosaves(self._submitted)
+
+    def stats(self) -> dict:
+        done = [j for j in self._submitted if j.status == JobStatus.DONE]
+        lat = [j.latency for j in done if j.latency is not None]
+        wall = (time.time() - self._t0) if self._t0 else 0.0
+        return {
+            "num_jobs": len(self._submitted),
+            "num_done": len(done),
+            "num_failed": sum(
+                j.status == JobStatus.FAILED for j in self._submitted),
+            "num_aborted": sum(
+                j.status == JobStatus.ABORTED for j in self._submitted),
+            "num_slices": self.num_slices,
+            "wall_s": wall,
+            "jobs_per_min": (len(done) / wall * 60.0) if wall > 0 else 0.0,
+            "p50_latency_s": _percentile(lat, 50) if lat else None,
+            "p95_latency_s": _percentile(lat, 95) if lat else None,
+            "cache": self.cache.stats(),
+            "retries_total": sum(j.attempts - 1 for j in self._submitted),
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sirius-serve",
+        description="multi-job SCF serving engine (sirius_tpu.serve)",
+    )
+    p.add_argument("decks", nargs="+", help="JSON deck files (cli.py format)")
+    p.add_argument("--slices", type=int, default=1,
+                   help="device slices / concurrent jobs")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="submit each deck N times (cache warm-up study)")
+    p.add_argument("--priority", type=int, default=0)
+    p.add_argument("--deadline", type=float, default=None,
+                   help="per-job deadline in seconds from submission")
+    p.add_argument("--timeout", type=float, default=3600.0,
+                   help="overall wait bound in seconds")
+    p.add_argument("--stats_out", default=None,
+                   help="also write the stats JSON to this path")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"])
+    args = p.parse_args(argv)
+
+    import os
+
+    for d in args.decks:
+        if not os.path.isfile(d):
+            print(f"sirius-serve: deck not found: {d}", file=sys.stderr)
+            return 2
+
+    import jax
+
+    if args.platform:
+        jax.config.update(
+            "jax_platforms",
+            "axon" if args.platform == "tpu" else args.platform,
+        )
+
+    eng = ServeEngine(num_slices=args.slices, verbose=True)
+    eng.start()
+    for rep in range(args.repeat):
+        for path in args.decks:
+            with open(path) as f:
+                deck = json.load(f)
+            name = os.path.splitext(os.path.basename(path))[0]
+            eng.submit(
+                deck, job_id=f"{name}-{rep}", priority=args.priority,
+                deadline=(time.time() + args.deadline
+                          if args.deadline else None),
+                base_dir=os.path.dirname(os.path.abspath(path)) or ".",
+            )
+    ok = eng.wait_all(timeout=args.timeout)
+    eng.shutdown(wait=True)
+    stats = eng.stats()
+    stats["jobs"] = [j.to_dict() for j in eng._submitted]
+    print(json.dumps(stats, indent=2, default=float))
+    if args.stats_out:
+        with open(args.stats_out, "w") as f:
+            json.dump(stats, f, indent=2, default=float)
+    if not ok:
+        print("sirius-serve: timed out waiting for jobs", file=sys.stderr)
+        return 3
+    return 1 if stats["num_failed"] or stats["num_aborted"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,162 @@
+"""Priority job queue with deadlines and per-job lifecycle events.
+
+Jobs carry the same JSON deck dict that cli.py consumes. Lifecycle:
+queued -> compiling -> running -> done | failed | aborted; every
+transition is appended to ``job.events`` as (timestamp, status, detail)
+so a client can reconstruct what happened to its job. Higher ``priority``
+pops first; among equal priorities the earlier ``deadline`` (then FIFO
+order) wins. A job whose deadline has already passed when it reaches the
+front is aborted instead of run — serving semantics: a late answer is a
+wrong answer.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+
+class JobStatus:
+    QUEUED = "queued"
+    COMPILING = "compiling"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    ABORTED = "aborted"
+
+
+class Job:
+    """One SCF request: a deck dict plus scheduling metadata."""
+
+    def __init__(self, deck: dict, job_id: str | None = None,
+                 base_dir: str = ".", priority: int = 0,
+                 deadline: float | None = None, max_retries: int = 2):
+        self.id = job_id or f"job-{id(self):x}"
+        self.deck = deck
+        self.base_dir = base_dir
+        self.priority = int(priority)
+        self.deadline = deadline  # absolute time.time() bar, None = none
+        self.max_retries = int(max_retries)
+        self.status = JobStatus.QUEUED
+        self.events: list[tuple[float, str, str]] = []
+        self.result: dict | None = None
+        self.error: str | None = None
+        self.permanent = False  # classified non-retryable (bad input)
+        self.attempts = 0
+        self.resume_path: str | None = None  # autosave to resume from
+        self.submitted_at: float | None = None
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+        self._done = threading.Event()
+
+    def _transition(self, status: str, detail: str = "") -> None:
+        self.status = status
+        self.events.append((time.time(), status, detail))
+        if status in (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED):
+            self.finished_at = time.time()
+            self._done.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the job reaches a terminal status."""
+        return self._done.wait(timeout)
+
+    @property
+    def latency(self) -> float | None:
+        """Submit-to-terminal wall time (the serving latency metric)."""
+        if self.submitted_at is None or self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "status": self.status,
+            "priority": self.priority,
+            "attempts": self.attempts,
+            "latency_s": self.latency,
+            "error": self.error,
+            "permanent": self.permanent,
+            "events": [
+                {"t": t, "status": s, "detail": d} for t, s, d in self.events
+            ],
+        }
+
+
+class JobQueue:
+    """Thread-safe priority queue (highest priority first, then earliest
+    deadline, then submit order)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._heap: list[tuple] = []
+        self._seq = itertools.count()
+        self._closed = False
+        self.jobs: dict[str, Job] = {}
+
+    def submit(self, job: Job) -> Job:
+        with self._not_empty:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            job.submitted_at = time.time()
+            job._transition(JobStatus.QUEUED)
+            self.jobs[job.id] = job
+            heapq.heappush(self._heap, (
+                -job.priority,
+                job.deadline if job.deadline is not None else float("inf"),
+                next(self._seq),
+                job,
+            ))
+            self._not_empty.notify()
+        return job
+
+    def requeue(self, job: Job, detail: str = "") -> None:
+        """Put a transiently-failed job back (retry/resume path)."""
+        with self._not_empty:
+            if self._closed:
+                job._transition(JobStatus.ABORTED, "queue closed")
+                return
+            job._transition(JobStatus.QUEUED, detail)
+            heapq.heappush(self._heap, (
+                -job.priority,
+                job.deadline if job.deadline is not None else float("inf"),
+                next(self._seq),
+                job,
+            ))
+            self._not_empty.notify()
+
+    def pop(self, timeout: float | None = None) -> Job | None:
+        """Next runnable job; None on timeout or when closed and drained.
+        Deadline-expired jobs are aborted here, never returned."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._not_empty:
+            while True:
+                while self._heap:
+                    _, _, _, job = heapq.heappop(self._heap)
+                    if (job.deadline is not None
+                            and time.time() > job.deadline):
+                        job._transition(
+                            JobStatus.ABORTED, "deadline expired in queue")
+                        continue
+                    return job
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.time()
+                    if remaining <= 0 or not self._not_empty.wait(remaining):
+                        return None
+
+    def close(self) -> None:
+        """Stop accepting work; blocked pop() calls drain then return
+        None."""
+        with self._not_empty:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)

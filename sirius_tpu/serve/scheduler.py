@@ -1,0 +1,245 @@
+"""Device-slice scheduler: concurrent SCF jobs over a partitioned mesh.
+
+The global device list is split into ``num_slices`` contiguous slices;
+one worker thread drains the queue per slice (thread-per-slice — XLA
+execution releases the GIL, so slices genuinely overlap on CPU tests and
+would on real accelerators). Each job runs through the normal run_scf
+machinery — ScfSupervisor ladder, control.autosave_every checkpoints —
+with a job-scoped autosave path, so a failed or preempted job is retried
+and *resumed* from its newest valid autosave rather than restarted.
+
+Failure classification:
+  transient  -> requeue (up to job.max_retries), resuming from autosave:
+               SimulatedKill (injected preemption), ScfAbortError
+               (supervisor ladder exhausted — a rollback snapshot may
+               still converge from the autosave), CheckpointError (bad
+               autosave: the resume path is cleared first), OSError.
+  permanent  -> failed, never retried: UpfParseError and other
+               ValueError/NotImplementedError/KeyError deck problems —
+               re-running bad input cannot succeed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from sirius_tpu.serve import cache as cache_mod
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.utils.profiler import counters
+
+# SimulationContext building for synthetic decks monkeypatches
+# UnitCell.from_config (testing.py idiom); serialize every context build
+# so concurrent workers never see each other's patch
+_CTX_LOCK = threading.Lock()
+
+
+def build_job_context(cfg, base_dir: str = "."):
+    """SimulationContext for a deck Config.
+
+    A ``synthetic`` extra section ({"ultrasoft": bool, "positions": [...],
+    "supercell": n, "a": lattice const}) builds the in-memory Si-like test
+    species instead of reading species files — the species-file-free deck
+    form used by tests and tools/loadgen.py. Everything else (cutoffs,
+    k-mesh, control knobs incl. ngk_pad_quantum) comes from the normal
+    config sections.
+    """
+    from sirius_tpu.context import SimulationContext
+
+    syn = cfg.extra.get("synthetic") if isinstance(cfg.extra, dict) else None
+    if not syn:
+        with _CTX_LOCK:
+            return SimulationContext.create(cfg, base_dir)
+
+    import sirius_tpu.crystal.unit_cell as ucm
+    from sirius_tpu.testing import synthetic_silicon_type
+
+    a = float(syn.get("a", 10.26))
+    lattice = a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+    t = synthetic_silicon_type(ultrasoft=bool(syn.get("ultrasoft", True)))
+    positions = np.asarray(
+        syn.get("positions", [[0.0, 0, 0], [0.25, 0.25, 0.25]]),
+        dtype=np.float64,
+    )
+    n = int(syn.get("supercell", 1))
+    if n > 1:
+        shifts = np.array(
+            [[i, j, k]
+             for i in range(n) for j in range(n) for k in range(n)],
+            dtype=np.float64,
+        )
+        positions = (
+            (positions[None, :, :] + shifts[:, None, :]) / n
+        ).reshape(-1, 3)
+        lattice = lattice * n
+    uc = ucm.UnitCell(
+        lattice=lattice,
+        atom_types=[t],
+        type_of_atom=np.zeros(len(positions), dtype=np.int32),
+        positions=positions,
+        moments=np.zeros((len(positions), 3)),
+    )
+    with _CTX_LOCK:
+        orig = ucm.UnitCell.from_config
+        try:
+            ucm.UnitCell.from_config = staticmethod(lambda c, b=".": uc)
+            return SimulationContext.create(cfg, base_dir)
+        finally:
+            ucm.UnitCell.from_config = orig
+
+
+class SliceScheduler:
+    """Partition ``devices`` into ``num_slices`` and drain ``queue``."""
+
+    def __init__(self, queue: JobQueue, exec_cache, num_slices: int = 1,
+                 devices=None, autosave_every: int = 3,
+                 autosave_keep: int = 2, verbose: bool = False):
+        import jax
+
+        self.queue = queue
+        self.cache = exec_cache
+        devices = list(devices) if devices is not None else jax.devices()
+        num_slices = max(1, min(int(num_slices), len(devices)))
+        per = len(devices) // num_slices
+        self.slices = [
+            devices[i * per:(i + 1) * per] for i in range(num_slices)
+        ]
+        # leftover devices join the last slice rather than idling
+        self.slices[-1].extend(devices[num_slices * per:])
+        self.autosave_every = int(autosave_every)
+        self.autosave_keep = int(autosave_keep)
+        self.verbose = verbose
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for i, devs in enumerate(self.slices):
+            t = threading.Thread(
+                target=self._worker, args=(i, devs),
+                name=f"serve-slice-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def join(self, timeout: float | None = None) -> None:
+        for t in self._threads:
+            t.join(timeout)
+
+    def _worker(self, idx: int, devs) -> None:
+        while True:
+            job = self.queue.pop(timeout=0.5)
+            if job is None:
+                if self.queue._closed:
+                    return
+                continue
+            self._run_job(job, idx, devs)
+
+    def _run_job(self, job: Job, slice_idx: int, devs) -> None:
+        import jax
+
+        from sirius_tpu.config.schema import load_config
+        from sirius_tpu.dft.recovery import ScfAbortError
+        from sirius_tpu.dft.scf import run_scf
+        from sirius_tpu.io.checkpoint import CheckpointError
+        from sirius_tpu.io.upf import UpfParseError
+        from sirius_tpu.utils.faults import SimulatedKill
+
+        job.attempts += 1
+        cfg = None
+        try:
+            cfg = load_config(dict(job.deck))
+            # serve defaults: job-scoped autosaves with rotation so every
+            # job is resumable and none clobbers a neighbour's checkpoint
+            if not cfg.control.autosave_tag and not cfg.control.autosave_path:
+                cfg.control.autosave_tag = job.id
+            if not cfg.control.autosave_every:
+                cfg.control.autosave_every = self.autosave_every
+            if not cfg.control.autosave_keep:
+                cfg.control.autosave_keep = self.autosave_keep
+            ctx = build_job_context(cfg, job.base_dir)
+            key = cache_mod.bucket_key(cfg, ctx)
+            warm = self.cache.note_job(key)
+            job._transition(
+                JobStatus.RUNNING if warm else JobStatus.COMPILING,
+                f"slice {slice_idx}, bucket {'warm' if warm else 'cold'}",
+            )
+            if job.started_at is None:
+                job.started_at = job.events[-1][0]
+            compiles0 = cache_mod.backend_compiles_this_thread()
+            with jax.default_device(devs[0]):
+                result = run_scf(
+                    cfg, base_dir=job.base_dir, ctx=ctx,
+                    exec_cache=self.cache, devices=devs,
+                    resume=job.resume_path,
+                )
+            compiled = cache_mod.backend_compiles_this_thread() - compiles0
+            counters["serve.backend_compiles"] += compiled
+            result["serve"] = {
+                "job_id": job.id,
+                "slice": slice_idx,
+                "attempts": job.attempts,
+                "bucket_warm": warm,
+                "compiled_executables": compiled,
+            }
+            job.result = result
+            job._transition(
+                JobStatus.DONE,
+                f"E={result['energy']['total']:.10f} "
+                f"compiled={compiled}",
+            )
+        except SimulatedKill as e:
+            self._retry(job, cfg, f"preempted: {e}")
+        except CheckpointError as e:
+            # the autosave we tried to resume from is unusable: retry from
+            # scratch rather than looping on the same bad file
+            job.resume_path = None
+            self._retry(job, cfg, f"bad checkpoint: {e}", resume=False)
+        except UpfParseError as e:
+            self._fail(job, f"UPF parse error: {e}", permanent=True)
+        except (ValueError, NotImplementedError, KeyError) as e:
+            self._fail(job, f"bad deck: {type(e).__name__}: {e}",
+                       permanent=True)
+        except ScfAbortError as e:
+            self._retry(job, cfg, f"scf aborted: {e}")
+        except OSError as e:
+            self._retry(job, cfg, f"io error: {e}")
+        except Exception as e:  # a serving worker must outlive any job
+            self._fail(job, f"unexpected {type(e).__name__}: {e}",
+                       permanent=True)
+
+    def _retry(self, job: Job, cfg, detail: str, resume: bool = True) -> None:
+        from sirius_tpu.dft.scf import default_autosave_path
+        from sirius_tpu.io.checkpoint import find_resumable
+
+        counters["serve.retries"] += 1
+        if job.attempts > job.max_retries:
+            self._fail(job, f"{detail} (retries exhausted)")
+            return
+        if resume and cfg is not None:
+            auto = cfg.control.autosave_path or default_autosave_path(
+                cfg, job.base_dir)
+            job.resume_path = find_resumable(
+                auto, keep=int(cfg.control.autosave_keep))
+        if self.verbose:
+            print(f"[serve] retrying {job.id}: {detail} "
+                  f"(resume={job.resume_path})", flush=True)
+        self.queue.requeue(job, detail)
+
+    def _fail(self, job: Job, detail: str, permanent: bool = False) -> None:
+        job.error = detail
+        job.permanent = permanent
+        counters["serve.failures"] += 1
+        job._transition(JobStatus.FAILED, detail)
+
+    def cleanup_autosaves(self, jobs) -> None:
+        """Remove job-scoped autosave generations of terminal jobs."""
+        for job in jobs:
+            tag = job.id
+            base = os.path.join(job.base_dir, f"sirius_autosave.{tag}.h5")
+            for p in [base] + [f"{base}.{i}" for i in range(1, 10)]:
+                if os.path.exists(p):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass

@@ -6,46 +6,116 @@ counters (evp_work_count / num_loc_op_applied, davidson.hpp:834,
 sirius.scf.cpp:232-234). Device-side profiling composes with
 jax.profiler traces; this registry covers the host-orchestrated spans and
 produces the timers.json-style summary the reference emits at finalize.
+
+Concurrency: the serving engine (sirius_tpu/serve/) runs several SCF jobs
+on worker threads at once. Span stacks, timings, and counters are all
+thread-local so concurrent jobs cannot interleave each other's span trees
+or double-count work; ``collect()`` merges a snapshot across every thread
+that has recorded anything. The per-thread views keep the historical
+single-threaded semantics: ``reset_timers()`` / ``timer_report()`` /
+``dict(counters)`` inside a job see only that job's numbers.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
+from collections.abc import MutableMapping
 
-_STACK: list[str] = []
-_TIMINGS: dict[str, list[float]] = defaultdict(list)
-counters: dict[str, float] = defaultdict(float)
+_tls = threading.local()
+
+# Registry of every thread's (timings, counters) dicts so collect() can
+# produce a merged snapshot. Guarded by _registry_lock; entries are keyed
+# by thread ident and carry the thread name for attribution.
+_registry_lock = threading.Lock()
+_registry: dict[int, dict] = {}
+
+
+def _local() -> dict:
+    """This thread's profiler state, registering it on first touch."""
+    state = getattr(_tls, "state", None)
+    if state is None:
+        t = threading.current_thread()
+        state = {
+            "name": t.name,
+            "stack": [],
+            "timings": defaultdict(list),
+            "counters": defaultdict(float),
+        }
+        _tls.state = state
+        with _registry_lock:
+            _registry[t.ident] = state
+    return state
+
+
+class _ThreadLocalCounters(MutableMapping):
+    """Mapping facade over the calling thread's counter dict.
+
+    Modules do ``from ...profiler import counters`` and then
+    ``counters["x"] += 1`` / ``dict(counters)``; both must keep working
+    while resolving to per-thread storage at access time.
+    """
+
+    def _d(self) -> dict:
+        return _local()["counters"]
+
+    def __getitem__(self, key):
+        return self._d()[key]
+
+    def __setitem__(self, key, value):
+        self._d()[key] = value
+
+    def __delitem__(self, key):
+        del self._d()[key]
+
+    def __iter__(self):
+        return iter(dict(self._d()))
+
+    def __len__(self):
+        return len(self._d())
+
+    def __repr__(self):
+        return repr(dict(self._d()))
+
+    def clear(self):
+        self._d().clear()
+
+
+counters = _ThreadLocalCounters()
 
 
 @contextlib.contextmanager
 def profile(name: str):
     """Nested scoped timer: with profile("scf::band_solve"): ..."""
-    _STACK.append(name)
-    full = "/".join(_STACK)
+    state = _local()
+    stack = state["stack"]
+    stack.append(name)
+    full = "/".join(stack)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        _TIMINGS[full].append(time.perf_counter() - t0)
-        _STACK.pop()
+        state["timings"][full].append(time.perf_counter() - t0)
+        stack.pop()
 
 
 def add_time(name: str, dt: float) -> None:
     """Record an externally-measured span (same registry as profile())."""
-    _TIMINGS[name].append(dt)
+    _local()["timings"][name].append(dt)
 
 
 def reset_timers() -> None:
-    _TIMINGS.clear()
-    counters.clear()
+    """Clear the calling thread's timings and counters (per-job reset)."""
+    state = _local()
+    state["timings"].clear()
+    state["counters"].clear()
 
 
-def timer_report() -> dict:
-    """{name: {count, total, avg, min, max}} sorted by total time."""
+def _report(timings: dict[str, list[float]]) -> dict:
     out = {}
-    for name, ts in sorted(_TIMINGS.items(), key=lambda kv: -sum(kv[1])):
+    for name, ts in sorted(timings.items(), key=lambda kv: -sum(kv[1])):
         out[name] = {
             "count": len(ts),
             "total": sum(ts),
@@ -54,3 +124,43 @@ def timer_report() -> dict:
             "max": max(ts),
         }
     return out
+
+
+def timer_report() -> dict:
+    """{name: {count, total, avg, min, max}} for the calling thread,
+    sorted by total time."""
+    return _report(_local()["timings"])
+
+
+def collect() -> dict:
+    """Merged cross-thread snapshot.
+
+    Returns ``{"counters": summed, "timers": merged_report,
+    "threads": {name: report}}``. Counter values are summed across
+    threads; timing samples for the same span name are concatenated
+    before the report statistics are computed.
+    """
+    with _registry_lock:
+        states = [
+            {
+                "name": s["name"],
+                "timings": {k: list(v) for k, v in s["timings"].items()},
+                "counters": dict(s["counters"]),
+            }
+            for s in _registry.values()
+        ]
+    merged_counters: dict[str, float] = defaultdict(float)
+    merged_timings: dict[str, list[float]] = defaultdict(list)
+    per_thread: dict[str, dict] = {}
+    for s in states:
+        for k, v in s["counters"].items():
+            merged_counters[k] += v
+        for k, v in s["timings"].items():
+            merged_timings[k].extend(v)
+        if s["timings"] or s["counters"]:
+            per_thread[s["name"]] = _report(s["timings"])
+    return {
+        "counters": dict(merged_counters),
+        "timers": _report(merged_timings),
+        "threads": per_thread,
+    }

@@ -115,7 +115,7 @@ RESUME_DECK = dict(
 )
 
 
-def _scf(device_scf, autosave=None, kill_at=None, resume=None):
+def _scf(device_scf, autosave=None, kill_at=None, resume=None, keep=0):
     from sirius_tpu.dft.scf import run_scf
 
     ctx = synthetic_silicon_context(**RESUME_DECK)
@@ -123,6 +123,7 @@ def _scf(device_scf, autosave=None, kill_at=None, resume=None):
     if autosave:
         ctx.cfg.control.autosave_every = 1
         ctx.cfg.control.autosave_path = autosave
+        ctx.cfg.control.autosave_keep = keep
     if kill_at is not None:
         faults.install([("scf.autosave_kill", kill_at, "raise")])
     return run_scf(ctx.cfg, ctx=ctx, resume=resume)
@@ -147,6 +148,39 @@ def test_mid_scf_resume_is_bit_reproducible_host(tmp_path):
     # the recorded histories agree over the overlap too
     tail = np.asarray(r_full["etot_history"][6:])
     np.testing.assert_array_equal(np.asarray(r_res["etot_history"][6:]), tail)
+
+
+@pytest.mark.faults
+def test_autosave_rotation_and_resume_under_rotation(tmp_path):
+    """control.autosave_keep=N rotates autosave generations logrotate-style
+    (path, path.1, ... path.N-1); a killed run resumes from the newest valid
+    generation, and when that one is corrupt, find_resumable falls back to
+    the previous generation — which still converges to the same answer."""
+    from sirius_tpu.io.checkpoint import find_resumable
+
+    ck = str(tmp_path / "auto.h5")
+    r_full = _scf("off")
+    assert r_full["converged"]
+    with pytest.raises(faults.SimulatedKill):
+        _scf("off", autosave=ck, kill_at=5, keep=3)
+    faults.clear()
+    # killed after the iteration-5 save: generations 5 (ck), 4 (.1), 3 (.2);
+    # keep-last-3 means nothing older survives
+    assert os.path.exists(ck)
+    assert os.path.exists(ck + ".1") and os.path.exists(ck + ".2")
+    assert not os.path.exists(ck + ".3")
+    assert find_resumable(ck, keep=3) == ck
+    r_res = _scf("off", resume=ck)
+    assert r_res["converged"]
+    assert r_res["energy"]["total"] == r_full["energy"]["total"]
+    # corrupt the newest generation: the rotation provides the fallback
+    with open(ck, "r+b") as f:
+        f.truncate(64)
+    fallback = find_resumable(ck, keep=3)
+    assert fallback == ck + ".1"
+    r_res2 = _scf("off", resume=fallback)
+    assert r_res2["converged"]
+    assert r_res2["energy"]["total"] == r_full["energy"]["total"]
 
 
 @pytest.mark.faults

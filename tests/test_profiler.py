@@ -1,0 +1,78 @@
+"""utils/profiler.py thread safety: concurrent span stacks must stay
+disjoint and correctly nested per thread (the serve scheduler runs one SCF
+per slice thread), and collect() must merge counters and timers across
+threads."""
+
+import threading
+
+from sirius_tpu.utils import profiler
+
+
+def test_two_threads_have_disjoint_nested_span_trees():
+    barrier = threading.Barrier(2)
+    reports = {}
+    errors = []
+
+    def work(name):
+        try:
+            profiler.reset_timers()
+            profiler.counters.clear()
+            with profiler.profile(f"outer_{name}"):
+                # both threads are inside their outer span at the same time;
+                # a shared stack would interleave the nesting
+                barrier.wait(timeout=10)
+                with profiler.profile("inner"):
+                    pass
+                with profiler.profile("inner2"):
+                    with profiler.profile("leaf"):
+                        pass
+            profiler.counters[f"count_{name}"] += 2
+            barrier.wait(timeout=10)
+            reports[name] = profiler.timer_report()
+        except Exception as e:  # surfaced below: asserts in threads vanish
+            errors.append(e)
+
+    ts = [threading.Thread(target=work, args=(n,)) for n in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errors, errors
+
+    for name in ("a", "b"):
+        other = "b" if name == "a" else "a"
+        spans = set(reports[name])
+        assert spans == {
+            f"outer_{name}",
+            f"outer_{name}/inner",
+            f"outer_{name}/inner2",
+            f"outer_{name}/inner2/leaf",
+        }, spans
+        # nothing from the other thread leaked into this report
+        assert not any(f"outer_{other}" in s for s in spans)
+
+    merged = profiler.collect()
+    assert merged["counters"]["count_a"] == 2
+    assert merged["counters"]["count_b"] == 2
+    assert "outer_a/inner" in merged["timers"]
+    assert "outer_b/inner" in merged["timers"]
+
+
+def test_counters_are_thread_local_but_collect_sums():
+    profiler.counters.clear()
+    done = threading.Event()
+
+    def work():
+        profiler.counters.clear()
+        profiler.counters["shared_key"] += 5
+        done.set()
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(timeout=10)
+    assert done.is_set()
+    profiler.counters["shared_key"] += 1
+    # this thread only sees its own increment...
+    assert profiler.counters["shared_key"] == 1
+    # ...while collect() sums over every registered thread
+    assert profiler.collect()["counters"]["shared_key"] == 6

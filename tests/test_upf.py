@@ -16,6 +16,75 @@ FILES = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# typed parse errors (no reference data needed): truncated or malformed
+# files must raise UpfParseError naming the offending field, so the serving
+# engine can classify the job as permanently failed
+
+
+def _write(tmp_path, body: str) -> str:
+    p = tmp_path / "species.UPF"
+    p.write_text(body)
+    return str(p)
+
+
+MINIMAL_OK = """<UPF version="2.0.1">
+  <PP_HEADER element="Si" pseudo_type="NC" z_valence="4.0" mesh_size="3"
+             number_of_proj="1" number_of_wfc="0"/>
+  <PP_MESH><PP_R>0.0 0.1 0.2</PP_R></PP_MESH>
+  <PP_LOCAL>-1.0 -2.0 -3.0</PP_LOCAL>
+  <PP_NONLOCAL>
+    <PP_BETA.1 angular_momentum="0">0.0 0.5 0.0</PP_BETA.1>
+    <PP_DIJ>2.0</PP_DIJ>
+  </PP_NONLOCAL>
+</UPF>
+"""
+
+
+def test_minimal_upf_parses(tmp_path):
+    from sirius_tpu.io.upf import upf2_to_json
+
+    pp = upf2_to_json(_write(tmp_path, MINIMAL_OK))["pseudo_potential"]
+    assert pp["header"]["element"] == "Si"
+    assert pp["radial_grid"] == [0.0, 0.1, 0.2]
+    assert pp["D_ion"] == [1.0]  # Ry -> Ha
+    assert len(pp["beta_projectors"]) == 1
+
+
+@pytest.mark.parametrize("mutate, field", [
+    (lambda s: s[: len(s) // 2], "XML"),  # truncated mid-file
+    (lambda s: s.replace("<UPF ", "<QE_PP ").replace("</UPF>", "</QE_PP>"),
+     "UPF"),
+    (lambda s: s.replace(' z_valence="4.0"', ""), "PP_HEADER/z_valence"),
+    (lambda s: s.replace('mesh_size="3"', 'mesh_size="three"'),
+     "PP_HEADER/mesh_size"),
+    (lambda s: s.replace("<PP_MESH><PP_R>0.0 0.1 0.2</PP_R></PP_MESH>",
+                         "<PP_MESH/>"), "PP_MESH/PP_R"),
+    (lambda s: s.replace("0.0 0.5 0.0", "0.0 oops 0.0"),
+     "PP_NONLOCAL/PP_BETA.1"),
+    (lambda s: s.replace(' angular_momentum="0"', ""),
+     "PP_BETA.1/angular_momentum"),
+    (lambda s: s.replace("<PP_NONLOCAL>", "<PP_IGNORED>")
+               .replace("</PP_NONLOCAL>", "</PP_IGNORED>"), "PP_NONLOCAL"),
+])
+def test_malformed_upf_raises_typed_error_naming_field(tmp_path, mutate, field):
+    from sirius_tpu.io.upf import UpfParseError, upf2_to_json
+
+    path = _write(tmp_path, mutate(MINIMAL_OK))
+    with pytest.raises(UpfParseError) as ei:
+        upf2_to_json(path)
+    assert field in ei.value.field, (ei.value.field, field)
+    assert isinstance(ei.value, ValueError)  # serve classifies as permanent
+    assert path in str(ei.value)
+
+
+def test_missing_header_names_header(tmp_path):
+    from sirius_tpu.io.upf import UpfParseError, upf2_to_json
+
+    with pytest.raises(UpfParseError, match="PP_HEADER"):
+        upf2_to_json(_write(tmp_path, "<UPF version='2.0.1'></UPF>"))
+
+
 @requires_reference
 @pytest.mark.parametrize("fname", FILES)
 def test_upf2_converter_matches_shipped_json(fname):

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Serving load generator: replay a tier-1 deck mix through ServeEngine
+and report throughput + latency + cache hit rate to SERVE_BENCH.json.
+
+The mix is the tier-1 synthetic-silicon deck family (testing.py species,
+no reference files needed): a base deck repeated with perturbed atomic
+positions (same shape bucket — the geometry-screening serving case, fully
+cache-shared) plus a second k-mesh variant (a second bucket). Padded
+shapes + the executable cache mean only the first job of each bucket
+compiles.
+
+Usage:
+    python tools/loadgen.py [--jobs N] [--slices S] [--out SERVE_BENCH.json]
+
+Exit status 0 = every job converged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def make_deck(positions=None, ngridk=(1, 1, 1), device_scf="auto") -> dict:
+    """A tier-1 synthetic-Si deck in cli.py JSON form."""
+    deck = {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": list(ngridk),
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": 40,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+        "control": {
+            "device_scf": device_scf,
+            "ngk_pad_quantum": 16,
+        },
+        "synthetic": {"ultrasoft": True},
+    }
+    if positions is not None:
+        deck["synthetic"]["positions"] = positions
+    return deck
+
+
+def deck_mix(num_jobs: int) -> list[dict]:
+    """num_jobs decks: perturbed-position family + a 2x1x1-kmesh variant."""
+    mix = []
+    for i in range(num_jobs):
+        if i % 4 == 3:
+            mix.append(make_deck(ngridk=(2, 1, 1)))
+        else:
+            d = 0.002 * (i % 4)
+            mix.append(make_deck(
+                positions=[[0.0, 0.0, 0.0],
+                           [0.25 + d, 0.25 - d, 0.25 + d]],
+            ))
+    return mix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual CPU device count (0 = leave platform as-is);"
+                         " >1 per slice keeps the fused/exec-cache path on")
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Must happen before jax initializes: a 1-device gamma-point run takes
+    # the serial gamma path and never builds FusedScf, so the executable
+    # cache would sit idle. Virtual devices give every slice a real mesh.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if args.devices > 1 and "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
+    import tempfile
+
+    from sirius_tpu.serve.engine import ServeEngine
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sirius_loadgen_")
+    eng = ServeEngine(num_slices=args.slices, workdir=workdir, verbose=True)
+    eng.start()
+    for i, deck in enumerate(deck_mix(args.jobs)):
+        eng.submit(deck, job_id=f"lg-{i}")
+    ok = eng.wait_all(timeout=3600.0)
+    eng.shutdown(wait=True)
+
+    stats = eng.stats()
+    bench = {
+        "bench": "serve_loadgen",
+        "deck": "synthetic-Si gk=3.0 pw=7.0 nb=8 (tier-1 mix)",
+        "num_jobs": stats["num_jobs"],
+        "num_done": stats["num_done"],
+        "num_failed": stats["num_failed"],
+        "num_slices": stats["num_slices"],
+        "wall_s": stats["wall_s"],
+        "jobs_per_min": stats["jobs_per_min"],
+        "p50_latency_s": stats["p50_latency_s"],
+        "p95_latency_s": stats["p95_latency_s"],
+        "cache_hit_rate": stats["cache"]["hit_rate"],
+        "cache": stats["cache"],
+        "retries_total": stats["retries_total"],
+        "per_job": [j.to_dict() for j in eng._submitted],
+    }
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=2, default=float)
+    print(json.dumps({k: v for k, v in bench.items() if k != "per_job"},
+                     indent=2, default=float))
+    print(f"wrote {args.out}")
+    return 0 if (ok and stats["num_done"] == stats["num_jobs"]) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
