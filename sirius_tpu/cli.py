@@ -20,7 +20,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--task",
         default="ground_state_new",
-        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "ground_state_direct", "k_point_path", "eos"],
+        choices=["ground_state_new", "ground_state_restart", "ground_state_relax", "ground_state_direct", "k_point_path", "eos", "molecular_dynamics"],
         help="calculation task (reference sirius.scf task semantics)",
     )
     p.add_argument("--volume_scale0", type=float, default=0.95,
@@ -67,6 +67,16 @@ def main(argv: list[str] | None = None) -> int:
             print("sirius-scf: SCF driver not built yet in this revision", file=sys.stderr)
             return 2
         raise
+    if args.task == "molecular_dynamics":
+        from sirius_tpu.md.driver import run_md_from_file
+
+        if args.test_against:
+            print(
+                "sirius-scf: --test_against is not supported by the "
+                "molecular_dynamics task", file=sys.stderr,
+            )
+            return 2
+        return run_md_from_file(args.input)
     if args.task == "eos":
         from sirius_tpu.apps_util import run_eos
 

@@ -219,6 +219,35 @@ class HubbardConfig:
 
 
 @dataclasses.dataclass
+class MdConfig:
+    # Born-Oppenheimer molecular dynamics (sirius_tpu/md/): every step is a
+    # converged SCF + analytic forces; the SCF warm-starts from an ASPC-
+    # extrapolated (rho, psi) and reuses the fused step executable across
+    # steps (compile-once stepping). sirius_tpu extension — the reference
+    # is driven as an MD engine by host codes (CP2K/QE) instead.
+    dt_fs: float = 1.0  # time step [fs]
+    num_steps: int = 100
+    ensemble: str = "nve"  # nve | nvt_langevin | nvt_csvr
+    temperature_k: float = 300.0  # init (and NVT target) temperature [K]
+    thermostat_tau_fs: float = 100.0  # thermostat relaxation time [fs]
+    # ASPC predictor depth: number of previous steps entering the density/
+    # wave-function extrapolation (0/1 = reuse last step's state as-is)
+    extrapolation_order: int = 3
+    # aspc: Kolafa always-stable predictor(-corrector); poly: pure
+    # polynomial extrapolation (higher order, less damping); off: cold
+    # superposition-of-atoms start every step (debug / A-B baseline)
+    extrapolation_kind: str = "aspc"
+    extrapolate_psi: bool = True  # subspace-aligned psi extrapolation
+    trajectory_path: str = ""  # extended-XYZ output ("" = don't write)
+    seed: int = 42  # velocity init + thermostat noise (counter-based)
+    remove_com: bool = True  # zero total momentum at init
+    compute_stress: bool = False  # per-step stress tensor + pressure
+    # MD steps between /md restart checkpoints (0 disables); the file is
+    # control.autosave_path or <base_dir>/sirius_md_autosave[.tag].h5
+    autosave_every: int = 1
+
+
+@dataclasses.dataclass
 class UnitCellConfig:
     lattice_vectors: list = dataclasses.field(default_factory=lambda: [[1, 0, 0], [0, 1, 0], [0, 0, 1]])
     lattice_vectors_scale: float = 1.0
@@ -241,6 +270,7 @@ _SECTION_TYPES = {
     "settings": SettingsConfig,
     "unit_cell": UnitCellConfig,
     "hubbard": HubbardConfig,
+    "md": MdConfig,
 }
 
 
@@ -253,6 +283,7 @@ class Config:
     settings: SettingsConfig = dataclasses.field(default_factory=SettingsConfig)
     unit_cell: UnitCellConfig = dataclasses.field(default_factory=UnitCellConfig)
     hubbard: HubbardConfig = dataclasses.field(default_factory=HubbardConfig)
+    md: MdConfig = dataclasses.field(default_factory=MdConfig)
     # sections parsed but not yet consumed (nlcg, vcsqnm)
     extra: dict = dataclasses.field(default_factory=dict)
 

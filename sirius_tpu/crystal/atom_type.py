@@ -71,6 +71,26 @@ class AtomType:
     paw: dict | None = None
     paw_core_energy: float = 0.0
     cutoff_radius_index: int | None = None  # PAW partial-wave truncation
+    mass: float = 0.0  # atomic mass [amu] from the species file (0 = unset)
+
+    @property
+    def mass_amu(self) -> float:
+        """Atomic mass [amu] for dynamics: the species-file value when
+        present, else the standard atomic weight of the element symbol
+        (reference atom_type mass handling: UPF header mass with the
+        periodic-table fallback)."""
+        if self.mass > 0.0:
+            return float(self.mass)
+        from sirius_tpu.lapw.free_atom import MASSES, SYMBOLS
+
+        sym = self.symbol.strip()
+        if sym in SYMBOLS:
+            return float(MASSES[SYMBOLS.index(sym)])
+        raise ValueError(
+            f"atom type '{self.label}': no mass in the species file and "
+            f"symbol '{sym}' is not a known element — set "
+            "pseudo_potential.header.mass"
+        )
 
     @property
     def spin_orbit(self) -> bool:
@@ -180,6 +200,7 @@ class AtomType:
             rho_total=np.asarray(rho_tot, dtype=np.float64) if rho_tot is not None else None,
             rho_core=np.asarray(rho_core, dtype=np.float64)[:nr] if rho_core is not None else None,
             core_correction=bool(h.get("core_correction", False)),
+            mass=float(h.get("mass", data.get("mass", 0.0)) or 0.0),
             paw=pp.get("paw_data"),
             paw_core_energy=float(h.get("paw_core_energy", 0.0)),
             cutoff_radius_index=(

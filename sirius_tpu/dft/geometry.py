@@ -1,0 +1,71 @@
+"""Shared plumbing for geometry drivers (relaxation, molecular dynamics).
+
+Both dft/relax.py and md/driver.py step atomic positions and re-run SCF at
+each geometry; the pieces they share live here:
+
+- `context_at_positions`: a SimulationContext at displaced positions of an
+  existing cell (fixed lattice/species/k-set), so every step's context has
+  identical array shapes — the executable-cache contract that makes a
+  geometry loop compile once.
+- `delta_density_guess`: the QE-style delta-density warm start across a
+  geometry step — carry the bonding rearrangement (rho_prev - rho_atomic at
+  the OLD positions), move the atomic superposition to the new positions.
+- `warm_start_state`: assemble a run_scf `initial_state` dict from a
+  previous step's `_state` plus (optionally) extrapolated/predicted fields.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def context_at_positions(cfg, base_dir: str, positions, uc0):
+    """SimulationContext of `cfg` with the atoms of `uc0` moved to the
+    given fractional positions (wrapped into the cell). Lattice, species
+    and every cutoff are unchanged, so all derived array shapes (G sets,
+    |G+k| spheres, projector tables) are identical to the original
+    context — geometry steps reuse the same compiled executables."""
+    import sirius_tpu.context as cm
+    import sirius_tpu.crystal.unit_cell as ucm
+
+    uc = ucm.UnitCell(
+        lattice=uc0.lattice,
+        atom_types=uc0.atom_types,
+        type_of_atom=uc0.type_of_atom,
+        positions=np.mod(np.asarray(positions, dtype=np.float64), 1.0),
+        moments=uc0.moments,
+    )
+    orig = ucm.UnitCell.from_config
+    try:
+        # SimulationContext.create reads species/positions from the config;
+        # substitute the in-memory cell (the established pattern of
+        # testing.py / relax.py, centralized here)
+        ucm.UnitCell.from_config = staticmethod(lambda c, b=".": uc)
+        ctx = cm.SimulationContext.create(cfg, base_dir)
+    finally:
+        ucm.UnitCell.from_config = orig
+    return ctx
+
+
+def delta_density_guess(rho_prev, rho_at_old, rho_at_new):
+    """Delta-density extrapolation across a geometry step: the previous
+    step's converged density minus its superposition-of-atoms part, plus
+    the superposition at the NEW positions. Keeps the chemical-bonding
+    delta, moves the free-atom charge with the nuclei."""
+    return np.asarray(rho_prev) - np.asarray(rho_at_old) + np.asarray(rho_at_new)
+
+
+def warm_start_state(prev_state: dict | None, rho_g=None, psi=None) -> dict | None:
+    """run_scf `initial_state` dict for the next geometry step: previous
+    `_state` fields (mag/PAW ride along unchanged) with the density and/or
+    wave functions replaced by predicted values when given."""
+    if prev_state is None and rho_g is None and psi is None:
+        return None
+    state = dict(prev_state) if prev_state is not None else {}
+    if rho_g is not None:
+        state["rho_g"] = np.asarray(rho_g)
+    if psi is not None:
+        state["psi"] = np.asarray(psi)
+    if "rho_g" not in state:
+        return None
+    return state

@@ -4,7 +4,10 @@ here fixed-cell BFGS over Cartesian positions using the analytic forces).
 
 Each objective evaluation is a converged SCF; successive steps warm-start
 from the previous step's wave functions and a delta-extrapolated density
-(rho_prev - rho_atomic(old positions) + rho_atomic(new positions))."""
+(rho_prev - rho_atomic(old positions) + rho_atomic(new positions)). The
+geometry-step plumbing (fixed-shape context rebuild, delta-density guess,
+warm-start assembly) is shared with the MD driver via dft/geometry.py, and
+a shared ExecutableCache keeps the fused SCF compiled once across steps."""
 
 from __future__ import annotations
 
@@ -17,14 +20,23 @@ def relax_atoms(
     max_steps: int = 30,
     force_tol: float = 1e-4,
     ctx=None,
+    exec_cache=None,
 ) -> dict:
     import sirius_tpu.context as cm
-    import sirius_tpu.crystal.unit_cell as ucm
+    from sirius_tpu.dft.geometry import (
+        context_at_positions,
+        delta_density_guess,
+        warm_start_state,
+    )
     from sirius_tpu.dft.scf import run_scf
 
     cfg.control.print_forces = True
     if ctx is None:
         ctx = cm.SimulationContext.create(cfg, base_dir)
+    if exec_cache is None:
+        from sirius_tpu.serve.cache import ExecutableCache
+
+        exec_cache = ExecutableCache()
     uc0 = ctx.unit_cell
     lat = uc0.lattice
     pos = uc0.positions.copy()
@@ -36,24 +48,23 @@ def relax_atoms(
     def scf_at(positions):
         from sirius_tpu.dft.density import initial_density_g
 
-        uc = ucm.UnitCell(
-            lattice=lat, atom_types=uc0.atom_types, type_of_atom=uc0.type_of_atom,
-            positions=np.mod(positions, 1.0), moments=uc0.moments,
-        )
-        orig = ucm.UnitCell.from_config
-        try:
-            ucm.UnitCell.from_config = staticmethod(lambda c, b=".": uc)
-            c = cm.SimulationContext.create(cfg, base_dir)
-        finally:
-            ucm.UnitCell.from_config = orig
+        c = context_at_positions(cfg, base_dir, positions, uc0)
         rho_at = initial_density_g(c)
         state = warm["state"]
         if state is not None:
-            # delta-density extrapolation across the geometry step (QE-style):
-            # carry the bonding rearrangement, move the atomic superposition
-            state = dict(state)
-            state["rho_g"] = state["rho_g"] - warm["rho_at"] + rho_at
-        out = run_scf(cfg, ctx=c, initial_state=state, keep_state=True)
+            # delta-density extrapolation across the geometry step
+            # (QE-style): carry the bonding rearrangement, move the atomic
+            # superposition with the nuclei
+            state = warm_start_state(
+                state,
+                rho_g=delta_density_guess(
+                    state["rho_g"], warm["rho_at"], rho_at
+                ),
+            )
+        out = run_scf(
+            cfg, ctx=c, initial_state=state, keep_state=True,
+            exec_cache=exec_cache,
+        )
         warm["state"] = out.get("_state")
         warm["rho_at"] = rho_at
         return out
@@ -69,7 +80,12 @@ def relax_atoms(
         f = np.asarray(res["forces"])
         g = -f.ravel()  # gradient of free energy
         fmax = float(np.abs(f).max())
-        history.append({"step": step, "free": res["energy"]["free"], "fmax": fmax})
+        history.append({
+            "step": step,
+            "free": res["energy"]["free"],
+            "fmax": fmax,
+            "scf_iterations": int(res["num_scf_iterations"]),
+        })
         if fmax < force_tol:
             break
         if g_prev is not None:

@@ -117,10 +117,15 @@ def run_scf(
     resume: str | None = None,
     exec_cache=None,
     devices=None,
+    initial_guess: tuple | None = None,
 ) -> dict:
     """initial_state: optional in-memory warm start {rho_g, mag_g, psi}
     (e.g. the `_state` of a previous run_scf at nearby atomic positions,
-    used by relax/vcrelax between geometry steps). keep_state: attach that
+    used by relax/vcrelax between geometry steps). initial_guess: the
+    simple front door to the same machinery — a (rho_g, psi) pair (either
+    may be None) validated against the context shapes, e.g. an
+    extrapolated density and wave functions from an MD predictor.
+    keep_state: attach that
     state to the result as `_state` (costs a host copy of all wave
     functions; only geometry drivers ask for it). serial_bands: use the
     per-(k, spin) debug path instead of the production one-program batched
@@ -277,6 +282,25 @@ def run_scf(
             nk, ns, nb, ctx.gkvec.ngk_max,
         ):
             psi = np.asarray(prev_psi) * ctx.gkvec.mask[:, None, None, :]
+    if initial_guess is not None:
+        guess_rho, guess_psi = initial_guess
+        if guess_rho is not None:
+            guess_rho = np.asarray(guess_rho)
+            if guess_rho.shape != rho_g.shape:
+                raise ValueError(
+                    f"initial_guess density shape {guess_rho.shape} does not "
+                    f"match the context G set {rho_g.shape}"
+                )
+            rho_g = guess_rho.astype(np.complex128)
+        if guess_psi is not None:
+            guess_psi = np.asarray(guess_psi)
+            want = (nk, ns, nb, ctx.gkvec.ngk_max)
+            if guess_psi.shape != want:
+                raise ValueError(
+                    f"initial_guess wave-function shape {guess_psi.shape} "
+                    f"does not match (nk, ns, nb, ngk_max) = {want}"
+                )
+            psi = guess_psi * ctx.gkvec.mask[:, None, None, :]
     if _resume_psi is not None and _resume_psi.shape == (
         nk, ns, nb, ctx.gkvec.ngk_max,
     ):

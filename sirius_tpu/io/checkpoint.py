@@ -13,6 +13,12 @@ Layout (schema version 2):
         packed mixed vector, mixer history, residual tolerance, iteration
         counter and convergence histories — enough to restart an SCF run
         mid-loop bit-reproducibly on the host path.
+  /md:  molecular-dynamics restart state (optional; md/driver.py
+        md.autosave_every): step counter, positions/velocities/forces,
+        thermostat work, conserved-quantity history and the
+        density/wave-function extrapolation histories — enough to resume a
+        trajectory that replays identically to the uninterrupted run
+        (thermostat noise is counter-based, so no RNG state is stored).
 
 Writes are preemption-safe: the file is written to a same-directory temp
 path and atomically os.replace()d over the target, so a kill mid-save never
@@ -71,10 +77,13 @@ def save_state(
     band_occupancies: np.ndarray | None = None,
     paw_dm: np.ndarray | None = None,
     scf_state: dict | None = None,
+    md_state: dict | None = None,
     rotate_keep: int = 0,
 ) -> None:
     """scf_state: optional mid-SCF resume payload (run_scf autosave):
     scalar entries become /scf attrs, array entries /scf datasets.
+    md_state: optional MD trajectory restart payload (md/driver.py),
+    encoded the same way under /md.
 
     rotate_keep: keep the last N snapshots by shifting path -> path.1 ->
     ... -> path.(N-1) (logrotate style) before the atomic rename; 0 keeps
@@ -127,9 +136,11 @@ def save_state(
                     ks.create_dataset(
                         "band_occupancies", data=np.asarray(band_occupancies)
                     )
-            if scf_state is not None:
-                sg = f.create_group("scf")
-                for k, v in scf_state.items():
+            for gname, payload in (("scf", scf_state), ("md", md_state)):
+                if payload is None:
+                    continue
+                sg = f.create_group(gname)
+                for k, v in payload.items():
                     if v is None:
                         continue
                     a = np.asarray(v)
@@ -331,19 +342,20 @@ def load_state(path: str, ctx, verify_checksum: bool = True) -> dict:
             for k in ("band_energies", "band_occupancies"):
                 if k in f["kset"]:
                     out[k] = f["kset"][k][...]
-        if "scf" in f:
-            # mid-SCF state rides the exact G enumeration it was saved
+        for gname in ("scf", "md"):
+            # mid-SCF / MD state rides the exact G enumeration it was saved
             # with: a remapped (strained) restart invalidates the packed
-            # mixer vector/history, so it is only returned on exact match
-            if g_map is None:
-                sg = f["scf"]
-                scf: dict = {
+            # mixer vector and the extrapolation histories, so these groups
+            # are only returned on exact match
+            if gname in f and g_map is None:
+                sg = f[gname]
+                payload: dict = {
                     k: v.decode() if isinstance(v, bytes) else v
                     for k, v in sg.attrs.items()
                 }
                 for k in sg:
-                    scf[k] = sg[k][...]
-                out["scf"] = scf
+                    payload[k] = sg[k][...]
+                out[gname] = payload
     return out
 
 

@@ -1,0 +1,23 @@
+"""Born-Oppenheimer molecular dynamics on the TPU-native SCF engine.
+
+- integrator.py: velocity-Verlet NVE plus Langevin and Bussi/CSVR NVT
+  thermostats, mass handling, conserved-quantity tracking
+- extrapolate.py: ASPC density extrapolation and subspace-aligned
+  wave-function extrapolation across steps
+- driver.py: the step loop (run_scf -> total_forces -> integrate) with
+  compile-once executable reuse, trajectory writing and /md restart
+"""
+
+from sirius_tpu.md.driver import run_md, run_md_from_file  # noqa: F401
+from sirius_tpu.md.extrapolate import (  # noqa: F401
+    AspcExtrapolator,
+    SubspaceExtrapolator,
+    aspc_coefficients,
+    poly_coefficients,
+)
+from sirius_tpu.md.integrator import (  # noqa: F401
+    ConservedTracker,
+    Thermostat,
+    masses_au,
+    maxwell_boltzmann_velocities,
+)

@@ -1,0 +1,174 @@
+"""Predictor state across MD/geometry steps: ASPC density extrapolation
+and subspace-aligned wave-function extrapolation.
+
+Each Born-Oppenheimer step's SCF needs an initial (rho, psi). Restarting
+from the superposition of atomic densities every step ("cold start")
+costs the full SCF iteration count at every geometry; extrapolating the
+converged states of the previous steps starts the SCF inside the
+convergence basin and cuts the iterations per step severalfold — the
+standard MD-embedding technique (CP2K's ASPC extrapolation; QE's
+pot/wfc extrapolation).
+
+Two coefficient families over the last m converged values x(t), x(t-h),
+... (newest first):
+
+- `aspc_coefficients(m)` — Kolafa's always-stable predictor-corrector
+  (J. Comput. Chem. 25, 335 (2004)):
+
+      B_j = (-1)^(j+1) j C(2m, m-j) / C(2m-2, m-1),   j = 1..m
+
+  ({2,-1}, {5/2,-2,1/2}, {14/5,-14/5,6/5,-1/5}, ...). The predictor is
+  exact on linear trajectories only: the higher-order Taylor terms are
+  deliberately damped, which is what keeps the predictor-corrector loop
+  stable at every order when the SCF "corrector" is not iterated to full
+  self-consistency. The matching corrector mixing is
+  `aspc_omega(m) = m/(2m-1)`.
+
+- `poly_coefficients(m)` — pure Lagrange/forward-difference
+  extrapolation, c_j = (-1)^(j+1) C(m, j) ({2,-1}, {3,-3,1}, ...): exact
+  on polynomial trajectories up to degree m-1 (a 3-point predictor
+  reproduces a quadratic trajectory exactly), at the price of amplifying
+  noise. For tightly converged BOMD (this driver converges every step)
+  both work; `md.extrapolation_kind` selects.
+
+Wave functions additionally carry a gauge freedom: the SCF returns an
+arbitrary unitary mix within degenerate/occupied subspaces, so raw
+psi(t) - psi(t-h) differences are dominated by gauge noise. The subspace
+extrapolator first aligns each new psi to the running gauge by the polar
+decomposition of the band-overlap matrix (the orthogonal Procrustes
+rotation), then extrapolates the aligned coefficients.
+"""
+
+from __future__ import annotations
+
+from math import comb
+
+import numpy as np
+
+KINDS = ("aspc", "poly", "off")
+
+
+def aspc_coefficients(m: int) -> np.ndarray:
+    """Kolafa ASPC predictor coefficients over the last m values (newest
+    first). m=1 degenerates to reusing the last value."""
+    if m < 1:
+        raise ValueError(f"aspc_coefficients: need m >= 1, got {m}")
+    if m == 1:
+        return np.array([1.0])
+    den = comb(2 * m - 2, m - 1)
+    return np.array(
+        [(-1) ** (j + 1) * j * comb(2 * m, m - j) / den for j in range(1, m + 1)]
+    )
+
+
+def aspc_omega(m: int) -> float:
+    """Corrector mixing weight paired with aspc_coefficients(m):
+    x(t+h) = omega x_scf + (1 - omega) x_pred (Kolafa's
+    omega = (k+2)/(2k+3) with k = m - 2)."""
+    if m < 2:
+        return 1.0
+    return m / (2.0 * m - 1.0)
+
+
+def poly_coefficients(m: int) -> np.ndarray:
+    """Polynomial (forward-difference) extrapolation coefficients: exact
+    for trajectories polynomial in time up to degree m-1."""
+    if m < 1:
+        raise ValueError(f"poly_coefficients: need m >= 1, got {m}")
+    return np.array([(-1) ** (j + 1) * comb(m, j) for j in range(1, m + 1)],
+                    dtype=np.float64)
+
+
+def _coefficients(kind: str, m: int) -> np.ndarray:
+    return aspc_coefficients(m) if kind == "aspc" else poly_coefficients(m)
+
+
+class AspcExtrapolator:
+    """Field extrapolator over a bounded history of converged values.
+
+    order: maximum history depth (number of previous steps used; 1 =
+    reuse the last value). kind: 'aspc' | 'poly' | 'off'. The corrector
+    mixing (ASPC omega) is applied in push() so the stored history is the
+    actual predictor-corrector trajectory; with use_corrector=False the
+    raw SCF output is stored (pure predictor, right for tightly converged
+    BOMD where the SCF result is the ground truth)."""
+
+    def __init__(self, order: int, kind: str = "aspc",
+                 use_corrector: bool = False):
+        if kind not in KINDS:
+            raise ValueError(f"unknown extrapolation kind '{kind}' "
+                             f"(known: {KINDS})")
+        self.order = max(int(order), 0)
+        self.kind = kind
+        self.use_corrector = bool(use_corrector) and kind == "aspc"
+        self.history: list[np.ndarray] = []  # newest first
+
+    def predict(self):
+        """Predicted next value, or None (cold start) when disabled or
+        no history exists yet."""
+        if self.kind == "off" or self.order < 1 or not self.history:
+            return None
+        m = min(len(self.history), self.order)
+        c = _coefficients(self.kind, m)
+        out = c[0] * self.history[0]
+        for j in range(1, m):
+            out = out + c[j] * self.history[j]
+        return out
+
+    def push(self, x_scf: np.ndarray) -> None:
+        """Record a converged value (newest first, bounded history)."""
+        if self.kind == "off" or self.order < 1:
+            return
+        x = np.asarray(x_scf)
+        if self.use_corrector and self.history:
+            pred = self.predict()
+            w = aspc_omega(min(len(self.history) + 1, self.order))
+            x = w * x + (1.0 - w) * pred
+        self.history.insert(0, x)
+        del self.history[self.order:]
+
+    def export(self) -> np.ndarray | None:
+        """Checkpointable stack [m, ...] (newest first), None when empty."""
+        if not self.history:
+            return None
+        return np.stack(self.history)
+
+    def restore(self, stack) -> None:
+        if stack is None:
+            self.history = []
+            return
+        a = np.asarray(stack)
+        self.history = [a[i] for i in range(min(a.shape[0], self.order))]
+
+
+def align_subspace(psi_new: np.ndarray, psi_ref: np.ndarray) -> np.ndarray:
+    """Rotate the bands of psi_new ([nb, ngk], G-vector rows masked) into
+    the gauge of psi_ref: R = U V^H from the SVD of the band-overlap
+    C = psi_ref psi_new^H — the unitary minimizing
+    ||R psi_new - psi_ref||_F (orthogonal Procrustes)."""
+    c = psi_ref @ psi_new.conj().T
+    u, _, vh = np.linalg.svd(c)
+    return (u @ vh) @ psi_new
+
+
+class SubspaceExtrapolator(AspcExtrapolator):
+    """Wave-function extrapolator: every pushed psi [nk, ns, nb, ngk] is
+    first gauge-aligned per (k, spin) block against the newest history
+    member, so the whole history shares one smooth gauge chain and the
+    linear combination is meaningful."""
+
+    def push(self, psi: np.ndarray) -> None:
+        if self.kind == "off" or self.order < 1:
+            return
+        psi = np.asarray(psi)
+        if self.history:
+            ref = self.history[0]
+            aligned = np.empty_like(psi)
+            nk, ns = psi.shape[:2]
+            for ik in range(nk):
+                for ispn in range(ns):
+                    aligned[ik, ispn] = align_subspace(
+                        psi[ik, ispn], ref[ik, ispn]
+                    )
+            psi = aligned
+        super().push(psi)

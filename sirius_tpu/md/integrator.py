@@ -1,0 +1,269 @@
+"""MD integrator: velocity-Verlet with NVE / Langevin / Bussi-CSVR
+ensembles, in Hartree atomic units throughout.
+
+Conventions (all atomic units unless suffixed):
+  positions   cartesian bohr
+  velocities  bohr / a.u. time
+  forces      Ha / bohr
+  masses      electron masses (amu * 1822.888...)
+
+The thermostats are formulated as half-step velocity maps applied around
+the two velocity-Verlet kicks (the standard middle-point splitting):
+
+  Langevin  exact Ornstein-Uhlenbeck update over dt/2
+            v <- c v + sqrt((1 - c^2) kT / m) xi,   c = exp(-dt/(2 tau))
+  CSVR      Bussi-Donadio-Parrinello stochastic velocity rescaling
+            (canonical sampling through a single global rescale; J. Chem.
+            Phys. 126, 014101 (2007)) over dt/2
+
+Both accumulate the energy they inject/remove so a conserved quantity
+exists for every ensemble:
+
+  NVE       E_kin + E_pot
+  NVT       E_kin + E_pot - sum(thermostat work)   (Bussi's "effective
+            energy"; flat for a correct integration, drifts when dt is
+            too large — exactly the diagnostic MD needs)
+
+Thermostat noise is counter-based: every random draw is generated from
+`SeedSequence([seed, step, salt])`, so a restarted trajectory replays the
+identical noise stream from just (seed, step) — no RNG state to
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# CODATA-2018 conversion factors
+FS_TO_AU = 41.341374575751  # 1 fs in atomic time units
+AMU_TO_AU = 1822.888486209  # 1 amu in electron masses
+KB_HA = 3.166811563e-6  # Boltzmann constant [Ha/K]
+HA_TO_EV = 27.211386245988
+BOHR_TO_ANG = 0.529177210903
+
+ENSEMBLES = ("nve", "nvt_langevin", "nvt_csvr")
+
+
+def masses_au(unit_cell) -> np.ndarray:
+    """Per-atom masses [electron masses] from the cell's species
+    (crystal/atom_type.py mass_amu: species-file header mass or the
+    standard atomic weight of the element)."""
+    return np.array(
+        [unit_cell.atom_types[t].mass_amu * AMU_TO_AU
+         for t in unit_cell.type_of_atom],
+        dtype=np.float64,
+    )
+
+
+def _rng(seed: int, step: int, salt: int = 0) -> np.random.Generator:
+    """Counter-based generator: deterministic in (seed, step, salt) so a
+    resumed trajectory replays the same noise without serializing RNG
+    state."""
+    return np.random.default_rng(
+        np.random.SeedSequence([
+            int(seed) & 0xFFFFFFFF, int(step) & 0xFFFFFFFF,
+            int(salt) & 0xFFFFFFFF,
+        ])
+    )
+
+
+def num_dof(natoms: int, remove_com: bool) -> int:
+    """Translational degrees of freedom entering temperature estimates."""
+    n = 3 * natoms - (3 if (remove_com and natoms > 1) else 0)
+    return max(n, 1)
+
+
+def kinetic_energy(velocities: np.ndarray, masses: np.ndarray) -> float:
+    return float(0.5 * np.sum(masses[:, None] * velocities**2))
+
+
+def temperature_k(velocities: np.ndarray, masses: np.ndarray,
+                  remove_com: bool = True) -> float:
+    ndof = num_dof(len(masses), remove_com)
+    return 2.0 * kinetic_energy(velocities, masses) / (ndof * KB_HA)
+
+
+def remove_com_velocity(velocities: np.ndarray, masses: np.ndarray) -> np.ndarray:
+    """Zero the center-of-mass momentum (mass-weighted)."""
+    p = (masses[:, None] * velocities).sum(axis=0)
+    return velocities - p / masses.sum()
+
+
+def maxwell_boltzmann_velocities(
+    masses: np.ndarray,
+    temperature: float,
+    seed: int = 42,
+    remove_com: bool = True,
+) -> np.ndarray:
+    """Maxwell-Boltzmann velocities at `temperature` [K], COM-projected
+    and rescaled to the exact target (the conventional deterministic
+    init; temperature <= 0 returns zeros)."""
+    n = len(masses)
+    if temperature <= 0.0 or n == 0:
+        return np.zeros((n, 3))
+    rng = _rng(seed, -1)
+    v = rng.standard_normal((n, 3)) * np.sqrt(
+        KB_HA * temperature / masses[:, None]
+    )
+    if remove_com and n > 1:
+        v = remove_com_velocity(v, masses)
+    t_now = temperature_k(v, masses, remove_com)
+    if t_now > 0:
+        v *= np.sqrt(temperature / t_now)
+    return v
+
+
+@dataclasses.dataclass
+class Thermostat:
+    """Half-step velocity map for the configured ensemble.
+
+    apply() returns (new_velocities, injected_energy); the injected energy
+    (KE_after - KE_before) feeds the conserved-quantity tracker. `salt`
+    disambiguates the two half-steps of one MD step so they draw
+    independent noise.
+    """
+
+    ensemble: str  # nve | nvt_langevin | nvt_csvr
+    temperature: float  # target [K]
+    tau_fs: float  # relaxation time [fs]
+    seed: int = 42
+    remove_com: bool = True
+
+    def __post_init__(self):
+        if self.ensemble not in ENSEMBLES:
+            raise ValueError(
+                f"unknown ensemble '{self.ensemble}' (known: {ENSEMBLES})"
+            )
+        if self.ensemble != "nve" and self.temperature <= 0.0:
+            raise ValueError(
+                f"{self.ensemble}: temperature_k must be positive, got "
+                f"{self.temperature}"
+            )
+        if self.ensemble != "nve" and self.tau_fs <= 0.0:
+            raise ValueError(
+                f"{self.ensemble}: thermostat_tau_fs must be positive, got "
+                f"{self.tau_fs}"
+            )
+
+    def apply(
+        self,
+        velocities: np.ndarray,
+        masses: np.ndarray,
+        dt_half: float,
+        step: int,
+        salt: int,
+    ) -> tuple[np.ndarray, float]:
+        if self.ensemble == "nve":
+            return velocities, 0.0
+        ke0 = kinetic_energy(velocities, masses)
+        tau = self.tau_fs * FS_TO_AU
+        rng = _rng(self.seed, step, salt)
+        if self.ensemble == "nvt_langevin":
+            # exact OU propagation over dt_half: damping + matched noise
+            c = np.exp(-dt_half / tau)
+            sigma = np.sqrt(
+                (1.0 - c * c) * KB_HA * self.temperature / masses[:, None]
+            )
+            v = c * velocities + sigma * rng.standard_normal(velocities.shape)
+            if self.remove_com and len(masses) > 1:
+                # keep the total momentum zero: the noise otherwise pumps
+                # the COM mode while ndof counts 3N - 3
+                v = remove_com_velocity(v, masses)
+        else:  # nvt_csvr (Bussi stochastic velocity rescaling)
+            ndof = num_dof(len(masses), self.remove_com)
+            ke_bar = 0.5 * ndof * KB_HA * self.temperature
+            if ke0 <= 0.0:
+                # cold start: seed the kinetic energy from the target MB
+                # distribution instead of dividing by zero
+                v = maxwell_boltzmann_velocities(
+                    masses, self.temperature, seed=self.seed + step + salt,
+                    remove_com=self.remove_com,
+                )
+                return v, kinetic_energy(v, masses) - ke0
+            c = np.exp(-dt_half / tau)
+            r1 = rng.standard_normal()
+            # sum of (ndof - 1) squared normals ~ chi^2(ndof - 1)
+            s = (
+                2.0 * rng.standard_gamma(0.5 * (ndof - 1))
+                if ndof > 1 else 0.0
+            )
+            alpha2 = (
+                c
+                + (1.0 - c) * (ke_bar / (ndof * ke0)) * (r1 * r1 + s)
+                + 2.0 * r1 * np.sqrt(c * (1.0 - c) * ke_bar / (ndof * ke0))
+            )
+            v = velocities * np.sqrt(max(alpha2, 0.0))
+        return v, kinetic_energy(v, masses) - ke0
+
+
+class ConservedTracker:
+    """Per-step conserved-quantity bookkeeping.
+
+    record() accumulates thermostat work and stores the ensemble's
+    conserved quantity E_kin + E_pot - W_thermostat; drift() reports the
+    max deviation from the first recorded value (Ha, and Ha/atom)."""
+
+    def __init__(self, natoms: int):
+        self.natoms = max(int(natoms), 1)
+        self.w_thermostat = 0.0  # accumulated injected energy
+        self.history: list[float] = []
+
+    def add_work(self, de: float) -> None:
+        self.w_thermostat += float(de)
+
+    def record(self, e_kin: float, e_pot: float) -> float:
+        e_cons = float(e_kin) + float(e_pot) - self.w_thermostat
+        self.history.append(e_cons)
+        return e_cons
+
+    def drift(self) -> dict:
+        if not self.history:
+            return {"max_abs": 0.0, "max_abs_per_atom": 0.0}
+        h = np.asarray(self.history)
+        d = float(np.abs(h - h[0]).max())
+        return {"max_abs": d, "max_abs_per_atom": d / self.natoms}
+
+    def export(self) -> dict:
+        return {
+            "w_thermostat": self.w_thermostat,
+            "econs_history": np.asarray(self.history, dtype=np.float64),
+        }
+
+    def restore(self, state: dict) -> None:
+        self.w_thermostat = float(state.get("w_thermostat", 0.0))
+        self.history = [float(v) for v in state.get("econs_history", [])]
+
+
+def velocity_verlet_step(
+    positions: np.ndarray,
+    velocities: np.ndarray,
+    f_current: np.ndarray,
+    masses: np.ndarray,
+    dt: float,
+    thermostat: Thermostat,
+    step: int,
+    force_fn,
+    tracker: ConservedTracker | None = None,
+):
+    """One full velocity-Verlet step with the thermostat applied as
+    half-steps around the kicks (the middle/OBABO splitting):
+
+      v <- T(dt/2); v += (dt/2) f(t)/m; r += dt v;
+      f(t+dt) = force_fn(r)        # the caller's SCF+forces evaluation
+      v += (dt/2) f(t+dt)/m; v <- T(dt/2)
+
+    `force_fn(r_cart)` returns (f, e_pot, extra); returns (positions,
+    velocities, f_new, e_pot, extra)."""
+    v, de = thermostat.apply(velocities, masses, 0.5 * dt, step, salt=0)
+    if tracker is not None:
+        tracker.add_work(de)
+    v = v + 0.5 * dt * f_current / masses[:, None]
+    r = positions + dt * v
+    f_new, e_pot, extra = force_fn(r)
+    v = v + 0.5 * dt * f_new / masses[:, None]
+    v, de = thermostat.apply(v, masses, 0.5 * dt, step, salt=1)
+    if tracker is not None:
+        tracker.add_work(de)
+    return r, v, f_new, e_pot, extra

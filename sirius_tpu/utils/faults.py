@@ -14,6 +14,7 @@ Sites currently wired:
   scf.evals          corrupt the band-solve eigenvalues
   scf.band_stagnate  force the band-solve health check to report stagnation
   scf.autosave_kill  die (SimulatedKill or hard exit) right after an autosave
+  md.autosave_kill   die right after an MD trajectory checkpoint (md/driver)
   checkpoint.before_rename  die inside save_state between the temp-file
                             write and the atomic rename
 

@@ -1,0 +1,155 @@
+"""Extrapolation unit tests (sirius_tpu/md/extrapolate.py): the published
+Kolafa ASPC coefficient sets, exactness properties of both coefficient
+families, gauge alignment of wave functions, and the checkpoint
+export/restore roundtrip."""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.md.extrapolate import (
+    AspcExtrapolator,
+    SubspaceExtrapolator,
+    align_subspace,
+    aspc_coefficients,
+    aspc_omega,
+    poly_coefficients,
+)
+
+
+def test_aspc_published_coefficient_sets():
+    """The first Kolafa sets (J. Comput. Chem. 25, 335 (2004), table of
+    B_j): {2,-1}, {5/2,-2,1/2}, {14/5,-14/5,6/5,-1/5}."""
+    np.testing.assert_allclose(aspc_coefficients(1), [1.0])
+    np.testing.assert_allclose(aspc_coefficients(2), [2.0, -1.0])
+    np.testing.assert_allclose(aspc_coefficients(3), [2.5, -2.0, 0.5])
+    np.testing.assert_allclose(
+        aspc_coefficients(4), [14 / 5, -14 / 5, 6 / 5, -1 / 5]
+    )
+
+
+@pytest.mark.parametrize("m", range(1, 8))
+def test_coefficients_sum_to_one(m):
+    """Charge conservation: a normalized history extrapolates to a
+    normalized prediction iff the coefficients sum to 1."""
+    np.testing.assert_allclose(aspc_coefficients(m).sum(), 1.0, atol=1e-12)
+    np.testing.assert_allclose(poly_coefficients(m).sum(), 1.0, atol=1e-12)
+
+
+@pytest.mark.parametrize("m", range(2, 8))
+def test_predictors_linear_exact(m):
+    """Both families reproduce a linear trajectory exactly."""
+    t = np.arange(m, 0, -1.0)  # newest first; predict t = m+1
+    x = 3.0 * t + 1.0
+    want = 3.0 * (m + 1) + 1.0
+    np.testing.assert_allclose(aspc_coefficients(m) @ x, want, atol=1e-9)
+    np.testing.assert_allclose(poly_coefficients(m) @ x, want, atol=1e-9)
+
+
+def test_poly_predictor_quadratic_exact():
+    """The 3-point polynomial predictor is exact on a quadratic
+    trajectory (degree m-1 exactness) — the property the MD driver's
+    'poly' extrapolation_kind buys over damped ASPC."""
+    t = np.array([3.0, 2.0, 1.0])
+    x = 2.0 * t**2 - t + 0.5
+    want = 2.0 * 16 - 4 + 0.5
+    np.testing.assert_allclose(poly_coefficients(3) @ x, want, atol=1e-12)
+    # ASPC deliberately damps the curvature term (stability over order):
+    # it must NOT be quadratic-exact
+    assert abs(aspc_coefficients(3) @ x - want) > 1e-3
+
+
+def test_aspc_omega_values():
+    """Kolafa's corrector mixing omega = (k+2)/(2k+3) at history length
+    m = k+2: 2/3, 3/5, 4/7, ..."""
+    assert aspc_omega(1) == 1.0
+    np.testing.assert_allclose(aspc_omega(2), 2 / 3)
+    np.testing.assert_allclose(aspc_omega(3), 3 / 5)
+    np.testing.assert_allclose(aspc_omega(4), 4 / 7)
+
+
+def test_extrapolator_quadratic_trajectory_prediction():
+    """AspcExtrapolator in 'poly' mode predicts the next point of a
+    quadratic field trajectory exactly once 3 history members exist."""
+    ex = AspcExtrapolator(order=3, kind="poly")
+    assert ex.predict() is None  # cold start
+    g = np.linspace(0.0, 1.0, 11)
+    for t in (1.0, 2.0, 3.0):
+        ex.push(0.3 * t**2 + g * t - 0.1)
+    want = 0.3 * 16 + g * 4.0 - 0.1
+    np.testing.assert_allclose(ex.predict(), want, atol=1e-12)
+
+
+def test_extrapolator_history_bounded_and_off_mode():
+    ex = AspcExtrapolator(order=2, kind="aspc")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        ex.push(np.array([v]))
+    assert len(ex.history) == 2
+    off = AspcExtrapolator(order=3, kind="off")
+    off.push(np.array([1.0]))
+    assert off.predict() is None and off.export() is None
+    with pytest.raises(ValueError, match="kind"):
+        AspcExtrapolator(order=3, kind="banana")
+
+
+def test_extrapolator_export_restore_roundtrip():
+    ex = AspcExtrapolator(order=3, kind="aspc")
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        ex.push(rng.standard_normal(5))
+    ex2 = AspcExtrapolator(order=3, kind="aspc")
+    ex2.restore(ex.export())
+    np.testing.assert_allclose(ex2.predict(), ex.predict())
+    ex2.restore(None)
+    assert ex2.predict() is None
+
+
+def _random_orthonormal(nb, ng, seed):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((ng, nb)) + 1j * rng.standard_normal((ng, nb))
+    return np.linalg.qr(a)[0].T  # (nb, ng), orthonormal rows
+
+
+def test_align_subspace_undoes_gauge_scramble():
+    """A unitary band mix (the SCF's gauge freedom) is exactly undone by
+    the Procrustes alignment."""
+    psi = _random_orthonormal(4, 12, seed=1)
+    rng = np.random.default_rng(2)
+    u = np.linalg.qr(
+        rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    )[0]
+    scrambled = u @ psi
+    aligned = align_subspace(scrambled, psi)
+    np.testing.assert_allclose(aligned, psi, atol=1e-12)
+    # and alignment preserves orthonormality
+    np.testing.assert_allclose(
+        aligned @ aligned.conj().T, np.eye(4), atol=1e-12
+    )
+
+
+def test_subspace_extrapolator_gauge_invariant_prediction():
+    """Pushing gauge-scrambled copies of a fixed state must predict that
+    state (up to a global gauge), not gauge noise: the raw difference of
+    scrambled states is O(1), the aligned difference is 0."""
+    psi = _random_orthonormal(4, 16, seed=3)[None, None]  # [nk=1, ns=1, ...]
+    ex = SubspaceExtrapolator(order=3, kind="poly")
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        u = np.linalg.qr(
+            rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+        )[0]
+        ex.push(np.einsum("ab,ksbg->ksag", u, psi))
+    pred = ex.predict()
+    # prediction spans the same subspace as psi: projector distance ~ 0
+    p_pred = pred[0, 0].conj().T @ pred[0, 0]
+    p_ref = psi[0, 0].conj().T @ psi[0, 0]
+    np.testing.assert_allclose(p_pred, p_ref, atol=1e-10)
+
+
+def test_subspace_extrapolator_export_restore():
+    psi = _random_orthonormal(3, 10, seed=5)[None, None]
+    ex = SubspaceExtrapolator(order=2, kind="aspc")
+    ex.push(psi)
+    ex.push(psi * np.exp(0.3j))
+    ex2 = SubspaceExtrapolator(order=2, kind="aspc")
+    ex2.restore(ex.export())
+    np.testing.assert_allclose(ex2.predict(), ex.predict())

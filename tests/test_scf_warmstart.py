@@ -1,0 +1,89 @@
+"""Warm-start plumbing (run_scf initial_guess + dft/geometry.py): a good
+initial (rho, psi) must change how many SCF iterations convergence takes —
+and must NOT change what it converges to. Also covers the relaxation
+driver's warm-started geometry stepping after its refactor onto the shared
+geometry helpers."""
+
+import numpy as np
+import pytest
+
+from sirius_tpu.testing import synthetic_silicon_context
+
+DECK = dict(
+    gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+    ultrasoft=True, use_symmetry=False,
+    extra_params={"num_dft_iter": 40, "density_tol": 5e-9,
+                  "energy_tol": 1e-10},
+)
+
+
+@pytest.fixture(scope="module")
+def cold():
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx = synthetic_silicon_context(**DECK)
+    res = run_scf(ctx.cfg, ctx=ctx, keep_state=True)
+    assert res["converged"]
+    return ctx, res
+
+
+def test_initial_guess_changes_iterations_not_energy(cold):
+    """Restarting from the converged (rho, psi) converges in a fraction of
+    the cold iteration count to the same energy within 1e-10 Ha."""
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx, res = cold
+    state = res["_state"]
+    warm = run_scf(
+        ctx.cfg, ctx=ctx,
+        initial_guess=(state["rho_g"], state["psi"]),
+    )
+    assert warm["converged"]
+    assert warm["num_scf_iterations"] < res["num_scf_iterations"]
+    assert abs(warm["energy"]["total"] - res["energy"]["total"]) < 1e-10
+    assert abs(warm["energy"]["free"] - res["energy"]["free"]) < 1e-10
+
+
+def test_initial_guess_density_only(cold):
+    """A density-only guess (psi=None) is accepted and still converges to
+    the same answer."""
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx, res = cold
+    warm = run_scf(
+        ctx.cfg, ctx=ctx, initial_guess=(res["_state"]["rho_g"], None)
+    )
+    assert warm["converged"]
+    assert abs(warm["energy"]["total"] - res["energy"]["total"]) < 1e-9
+
+
+def test_initial_guess_shape_validation(cold):
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx, res = cold
+    with pytest.raises(ValueError, match="initial_guess density"):
+        run_scf(ctx.cfg, ctx=ctx, initial_guess=(np.zeros(7), None))
+    with pytest.raises(ValueError, match="initial_guess wave-function"):
+        run_scf(
+            ctx.cfg, ctx=ctx,
+            initial_guess=(None, np.zeros((1, 1, 2, 3), dtype=complex)),
+        )
+
+
+def test_relax_warm_start_reduces_iterations():
+    """Geometry steps of the relaxation driver warm-start from the
+    previous step (delta-density + wave functions via dft/geometry.py):
+    every post-first step must need fewer SCF iterations than the cold
+    first step, and the optimizer must actually descend."""
+    from sirius_tpu.dft.relax import relax_atoms
+
+    ctx = synthetic_silicon_context(
+        positions=np.array([[0.0, 0, 0], [0.22, 0.27, 0.24]]), **DECK
+    )
+    out = relax_atoms(ctx.cfg, ctx=ctx, max_steps=3, force_tol=1e-6)
+    h = out["history"]
+    assert len(h) == 3
+    assert all("scf_iterations" in step for step in h)
+    assert h[1]["scf_iterations"] < h[0]["scf_iterations"]
+    assert h[2]["scf_iterations"] < h[0]["scf_iterations"]
+    assert h[-1]["free"] < h[0]["free"] + 1e-12

@@ -1,0 +1,116 @@
+"""MD stepping benchmark: steps/min, SCF iterations per step with and
+without ASPC extrapolation, and the XLA recompile count across the
+trajectory (compile-once acceptance).
+
+Writes MD_BENCH.json next to the CWD. The A/B is the point: the same
+trajectory (same deck, same seed, same ensemble) is integrated once with
+the extrapolating warm start and once with extrapolation_kind='off'
+(superposition-of-atoms cold start every step); the ratio of mean SCF
+iterations per step is the payoff the md subsystem claims.
+
+Usage:
+    python tools/bench_md.py [--steps N] [--supercell N] [--dt-fs X]
+                             [--ensemble nve|nvt_langevin|nvt_csvr]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run_case(kind: str, args) -> dict:
+    import numpy as np
+
+    from sirius_tpu.md.driver import run_md
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(
+        gk_cutoff=args.gk_cutoff,
+        pw_cutoff=args.pw_cutoff,
+        ngridk=(1, 1, 1),
+        num_bands=8 * args.supercell**3,
+        ultrasoft=True,
+        use_symmetry=False,
+        supercell=args.supercell,
+        extra_params={
+            "num_dft_iter": 60,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+    )
+    cfg = ctx.cfg
+    cfg.md.num_steps = args.steps
+    cfg.md.dt_fs = args.dt_fs
+    cfg.md.ensemble = args.ensemble
+    cfg.md.temperature_k = 300.0
+    cfg.md.seed = 11
+    cfg.md.extrapolation_kind = kind
+    cfg.md.autosave_every = 0
+    t0 = time.time()
+    res = run_md(cfg, base_dir=".", ctx=ctx)
+    dt = time.time() - t0
+    iters = res["scf_iterations"]
+    return {
+        "extrapolation_kind": kind,
+        "steps": args.steps,
+        "elapsed_s": round(dt, 2),
+        "steps_per_minute": round(60.0 * args.steps / dt, 3),
+        "scf_iterations": iters,
+        "scf_iterations_first": iters[0],
+        # steady-state cost: skip the cold step-0 evaluation and the
+        # history build-up of the first trajectory steps
+        "mean_scf_iterations_per_step": round(float(np.mean(iters[1:])), 3),
+        "mean_scf_iterations_steady": round(
+            float(np.mean(iters[min(3, len(iters) - 1):])), 3
+        ),
+        "backend_compiles_total": res["backend_compiles_total"],
+        "backend_compiles_after_first_step":
+            res["backend_compiles_after_first_step"],
+        "drift_max_abs_ha": res["drift"]["max_abs"],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--supercell", type=int, default=1)
+    p.add_argument("--dt-fs", type=float, default=1.0)
+    p.add_argument("--ensemble", default="nve",
+                   choices=["nve", "nvt_langevin", "nvt_csvr"])
+    p.add_argument("--gk-cutoff", type=float, default=3.0)
+    p.add_argument("--pw-cutoff", type=float, default=7.0)
+    p.add_argument("--out", default="MD_BENCH.json")
+    args = p.parse_args(argv)
+
+    import jax
+
+    warm = run_case("aspc", args)
+    cold = run_case("off", args)
+    speedup = (
+        cold["mean_scf_iterations_steady"]
+        / max(warm["mean_scf_iterations_steady"], 1e-9)
+    )
+    out = {
+        "bench": "md_stepping",
+        "platform": jax.devices()[0].platform,
+        "deck": {
+            "supercell": args.supercell,
+            "gk_cutoff": args.gk_cutoff,
+            "pw_cutoff": args.pw_cutoff,
+            "ensemble": args.ensemble,
+            "dt_fs": args.dt_fs,
+        },
+        "with_extrapolation": warm,
+        "without_extrapolation": cold,
+        "scf_iteration_reduction": round(1.0 - 1.0 / speedup, 3),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, default=float)
+    print(json.dumps(out, indent=2, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
