@@ -38,7 +38,13 @@ def main(argv: list[str] | None = None) -> int:
         "jax, so this flag sets jax.config explicitly. Default: cpu when the "
         "deck requests processing_unit=cpu, else the jax default.",
     )
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="raise log level (-v info, -vv debug)")
     args = p.parse_args(argv)
+
+    from sirius_tpu.obs.log import setup as _log_setup
+
+    _log_setup(args.verbose)
 
     import json
     import os

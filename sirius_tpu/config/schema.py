@@ -94,6 +94,16 @@ class ControlConfig:
     # on abort, dump the supervisor diagnostic (sentinel, iteration,
     # last-good energies, ladder history) as JSON to this path ("" = off)
     diag_dump: str = ""
+    # observability (sirius_tpu/obs): telemetry=False turns every metric
+    # update into a no-op (overhead kill switch); events_path opens the
+    # JSONL event sink (run manifest, per-iteration records, recovery
+    # rungs, checkpoints, MD steps); trace_capture arms a jax.profiler
+    # capture of the first trace_capture_steps SCF iterations, written as
+    # a TensorBoard-readable trace directory at that path ("" = off)
+    telemetry: bool = True
+    events_path: str = ""
+    trace_capture: str = ""
+    trace_capture_steps: int = 5
 
 
 @dataclasses.dataclass

@@ -37,6 +37,14 @@ import json
 
 import numpy as np
 
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+
+_RECOVERIES = obs_metrics.REGISTRY.counter(
+    "scf_recoveries_total", "recovery-ladder rungs taken, by action")
+_ABORTS = obs_metrics.REGISTRY.counter(
+    "scf_aborts_total", "runs lost past the recovery ladder")
+
 # ladder rung -> human-readable action (diagnostic / log strings)
 LADDER = (
     "flush_history",
@@ -178,6 +186,8 @@ class ScfSupervisor:
             "action": action,
             "rolled_back_to": self._snap["it"],
         })
+        _RECOVERIES.inc(sentinel=sentinel, action=action)
+        obs_events.emit("recovery", **self.history[-1])
         d = RecoveryDirective(rung=rung, flush_history=True)
         if rung >= 1:
             d.beta = 0.5 * self.beta0
@@ -190,6 +200,10 @@ class ScfSupervisor:
     def _abort(self, sentinel: str, it: int, detail: str,
                state: dict | None) -> ScfAbortError:
         diag = self.diagnostic(sentinel, it, detail, state)
+        _ABORTS.inc(sentinel=sentinel)
+        obs_events.emit("recovery", iteration=it, sentinel=sentinel,
+                        detail=detail, rung=self.rung, action="abort",
+                        rolled_back_to=diag["last_good_iteration"])
         if self.diag_dump:
             try:
                 with open(self.diag_dump, "w") as f:

@@ -35,9 +35,30 @@ from sirius_tpu.ops.atomic import atomic_orbitals
 from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger
+from sirius_tpu.obs.trace import CAPTURE as obs_trace
 from sirius_tpu.utils import checksums as _cks
 from sirius_tpu.utils import faults
 from sirius_tpu.utils.profiler import counters, profile, timer_report
+
+logger = get_logger("dft.scf")
+
+_ITERATIONS = obs_metrics.REGISTRY.counter(
+    "scf_iterations_total", "SCF iterations executed")
+_ITER_SECONDS = obs_metrics.REGISTRY.histogram(
+    "scf_iteration_seconds", "wall time per SCF iteration",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0))
+_RMS = obs_metrics.REGISTRY.gauge(
+    "scf_density_rms", "latest density residual RMS")
+_ETOT = obs_metrics.REGISTRY.gauge(
+    "scf_total_energy_ha", "latest total energy [Ha]")
+_RUNS = obs_metrics.REGISTRY.counter(
+    "scf_runs_total", "run_scf completions by outcome")
+_AUTOSAVES = obs_metrics.REGISTRY.counter(
+    "scf_autosaves_total", "mid-run checkpoint writes")
 
 
 def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
@@ -147,6 +168,23 @@ def run_scf(
         # child processes (tools/soak_scf.py) inherit their fault plan via
         # the environment; in-process plans (faults.install) are untouched
         faults.load_env()
+    obs_metrics.set_enabled(bool(getattr(cfg.control, "telemetry", True)))
+    obs_metrics.install_jax_listeners()
+    if cfg.control.verbosity >= 1:
+        # deck-driven verbosity keeps printing per-iteration lines even
+        # when the CLI -v flag was not given
+        from sirius_tpu.obs.log import setup as _log_setup
+
+        _log_setup(cfg.control.verbosity)
+    if getattr(cfg.control, "events_path", ""):
+        ep = cfg.control.events_path
+        obs_events.configure(
+            ep if os.path.isabs(ep) else os.path.join(base_dir, ep))
+    if getattr(cfg.control, "trace_capture", ""):
+        tc = cfg.control.trace_capture
+        obs_trace.request(
+            tc if os.path.isabs(tc) else os.path.join(base_dir, tc),
+            steps=int(getattr(cfg.control, "trace_capture_steps", 5)))
     p = cfg.parameters
     if ctx is None:
         ctx = SimulationContext.create(cfg, base_dir)
@@ -790,11 +828,10 @@ def run_scf(
             "device_scf": fused is not None,
         })
         if cfg.control.verbosity >= 1:
-            print(
-                f"[scf] recovery at it={it + 1}: sentinel '{sentinel}' -> "
-                f"rung {d.rung} (rollback to it="
-                f"{sup.snap['it'] + 1})", flush=True,
-            )
+            logger.warning(
+                "recovery at it=%d: sentinel '%s' -> rung %d "
+                "(rollback to it=%d)",
+                it + 1, sentinel, d.rung, sup.snap["it"] + 1)
         snap = sup.snap
         x_mix = np.array(snap["x_mix"])
         if d.flush_history:
@@ -891,11 +928,23 @@ def run_scf(
             paw_dm=pdm_s, scf_state=scf_state,
             rotate_keep=int(getattr(cfg.control, "autosave_keep", 0)),
         )
+        _AUTOSAVES.inc()
+        obs_events.emit("autosave", it=it + 1, path=path,
+                        fused=fused is not None)
         # fault site: a preemption right after the autosave (soak test /
         # tests drive the resume path through this)
         faults.check("scf.autosave_kill", it)
 
+    obs_events.emit(
+        "run_manifest", nk=nk, ns=ns, nb=nb, ng=ng,
+        num_atoms=ctx.unit_cell.num_atoms, device_scf=fused is not None,
+        it0=it0, num_dft_iter=p.num_dft_iter, resumed=resume is not None,
+        xc=list(p.xc_functionals), precision_wf=p.precision_wf,
+    )
+    _it_t0 = time.time()
     for it in range(it0, p.num_dft_iter):
+        obs_trace.tick()
+        _it_t0 = time.time()
         # --- band solve per (k, spin) (warm start) ---
         if fused is None or fused_out is None:
             # host D/v0 from the host potential; once the fused step has
@@ -1351,10 +1400,9 @@ def run_scf(
                         # result rather than build a truncated dense H
                         pass
                 if rescued and cfg.control.verbosity >= 1:
-                    print(
-                        f"[scf] band-solve rescue at it={it + 1} "
-                        f"(max rnorm {rn_max:.2e})", flush=True,
-                    )
+                    logger.warning(
+                        "band-solve rescue at it=%d (max rnorm %.2e)",
+                        it + 1, rn_max)
         if _cks.enabled():
             _cks.checksum("evals", evals)
 
@@ -1428,13 +1476,19 @@ def run_scf(
             if polarized:
                 mag_history.append(float(fused_np[S_MAG]))
             num_iter_done = it + 1
+            _ITERATIONS.inc(path="fused")
+            _ITER_SECONDS.observe(time.time() - _it_t0)
+            _RMS.set(rms)
+            _ETOT.set(e_total)
+            obs_events.emit(
+                "scf_iteration", it=it + 1, path="fused", rms=rms,
+                e_total=e_total, dt=time.time() - _it_t0,
+                scalars=[float(v) for v in fused_np],
+            )
             if cfg.control.verbosity >= 2:
                 mg = f" mag={mag_history[-1]:+.4f}" if polarized else ""
-                print(
-                    f"[scf] it={it + 1:3d} etot={e_total:+.10f} "
-                    f"rms={rms:.3e}{mg}",
-                    flush=True,
-                )
+                logger.info("it=%3d etot=%+.10f rms=%.3e%s",
+                            it + 1, e_total, rms, mg)
             sentinel = sup.observe(it, rms, e_total)
             if sentinel is not None:
                 _recover(sentinel)
@@ -1707,13 +1761,24 @@ def run_scf(
             # each SCF step); recorded from the OUTPUT density pre-mix
             mag_history.append(float(np.real(mag_new[0]) * ctx.unit_cell.omega))
         num_iter_done = it + 1
+        _ITERATIONS.inc(path="host")
+        _ITER_SECONDS.observe(time.time() - _it_t0)
+        _RMS.set(rms)
+        _ETOT.set(e_total)
+        obs_events.emit(
+            "scf_iteration", it=it + 1, path="host", rms=rms,
+            e_total=e_total, dt=time.time() - _it_t0,
+            # host-path equivalent of the fused [16] scalar record
+            scalars={"eval_sum": eval_sum, "vha": e["vha"], "vxc": e["vxc"],
+                     "exc": e["exc"], "bxc": e["bxc"],
+                     "entropy": float(entropy_sum),
+                     "scf_correction": scf_correction},
+        )
         if cfg.control.verbosity >= 2:
             # reference per-iteration SCF line (dft_ground_state verbosity 2)
             mg = f" mag={mag_history[-1]:+.4f}" if polarized else ""
-            print(
-                f"[scf] it={it + 1:3d} etot={e_total:+.10f} rms={rms:.3e}{mg}",
-                flush=True,
-            )
+            logger.info("it=%3d etot=%+.10f rms=%.3e%s",
+                        it + 1, e_total, rms, mg)
 
         sentinel = sup.observe(it, rms, e_total)
         if sentinel is not None:
@@ -1749,6 +1814,7 @@ def run_scf(
             converged = True
             break
 
+    obs_trace.finish()
     # --- final report ---
     if fused is not None and fused_out is not None:
         # one-time exit fetch from the device-resident loop: mixed density,
@@ -1828,6 +1894,11 @@ def run_scf(
         "counters": dict(counters),
         "timers": timer_report(),
     }
+    _RUNS.inc(outcome="converged" if converged else "unconverged")
+    obs_events.emit(
+        "scf_done", converged=converged, iterations=num_iter_done,
+        e_total=e_total, recoveries=sup.recoveries, wall_s=result["scf_time"],
+    )
     if hub is not None:
         result["_hubbard_v"] = vhub  # ndarray, consumed by the band-path task
     if keep_state:

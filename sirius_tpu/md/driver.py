@@ -35,6 +35,23 @@ import warnings
 import numpy as np
 
 from sirius_tpu.md.extrapolate import AspcExtrapolator, SubspaceExtrapolator
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger, job_context
+
+logger = get_logger("md")
+
+_STEPS = obs_metrics.REGISTRY.counter(
+    "md_steps_total", "MD steps integrated")
+_STEP_SECONDS = obs_metrics.REGISTRY.histogram(
+    "md_step_seconds", "wall time per MD step")
+_SCF_PER_STEP = obs_metrics.REGISTRY.histogram(
+    "md_scf_iterations_per_step", "SCF iterations each MD step needed",
+    buckets=(1, 2, 3, 5, 8, 12, 20, 40, 80))
+_DRIFT = obs_metrics.REGISTRY.gauge(
+    "md_conserved_drift_ha", "conserved-quantity drift from step 0")
+_XERR = obs_metrics.REGISTRY.gauge(
+    "md_extrapolation_rel_error", "relative ASPC density prediction error")
 from sirius_tpu.md.integrator import (
     BOHR_TO_ANG,
     FS_TO_AU,
@@ -219,6 +236,17 @@ def run_md(
             )
         state = res["_state"]
         carry["state"] = state
+        xerr = None
+        if init is not None and init.get("rho_g") is not None:
+            # how good was the predictor? relative L2 distance between the
+            # extrapolated density and the converged one
+            rho_conv = np.asarray(state["rho_g"])
+            dnorm = np.linalg.norm(rho_conv)
+            if dnorm > 0:
+                xerr = float(
+                    np.linalg.norm(np.asarray(init["rho_g"]) - rho_conv)
+                    / dnorm)
+                _XERR.set(xerr)
         rho_x.push(state["rho_g"])
         psi_x.push(state["psi"])
         f = np.asarray(res["forces"], dtype=np.float64)
@@ -227,6 +255,7 @@ def run_md(
             "scf_iterations": int(res["num_scf_iterations"]),
             "converged": bool(res.get("converged", False)),
             "recovery": res.get("recovery"),
+            "extrapolation_error": xerr,
         }
         if md.compute_stress and "stress" in res:
             s = np.asarray(res["stress"], dtype=np.float64)
@@ -305,6 +334,8 @@ def run_md(
             paw_dm=state.get("paw_dm"),
             md_state=md_state,
         )
+        obs_events.emit("checkpoint", step=step_done, path=autosave_path,
+                        scope="md")
         # simulate preemption right after the durable checkpoint: the
         # resumed trajectory must replay the uninterrupted one
         faults.check("md.autosave_kill", step_done)
@@ -314,10 +345,14 @@ def run_md(
             tracker.record(kinetic_energy(velocities, masses), e_pot)
         for step in range(step0, md.num_steps):
             n0 = backend_compiles_total()
-            r_cart, velocities, f_cur, e_pot, extra = velocity_verlet_step(
-                r_cart, velocities, f_cur, masses, dt, thermostat, step,
-                lambda r: evaluate(r, step_index=step + 1), tracker,
-            )
+            t_step0 = time.time()
+            with job_context(step=step + 1):
+                r_cart, velocities, f_cur, e_pot, extra = (
+                    velocity_verlet_step(
+                        r_cart, velocities, f_cur, masses, dt, thermostat,
+                        step, lambda r: evaluate(r, step_index=step + 1),
+                        tracker,
+                    ))
             e_kin = kinetic_energy(velocities, masses)
             e_cons = tracker.record(e_kin, e_pot)
             rec = {
@@ -336,6 +371,16 @@ def run_md(
             if "pressure_gpa" in extra:
                 rec["pressure_gpa"] = extra["pressure_gpa"]
             records.append(rec)
+            _STEPS.inc()
+            _STEP_SECONDS.observe(time.time() - t_step0)
+            _SCF_PER_STEP.observe(rec["scf_iterations"])
+            drift_now = tracker.drift()
+            _DRIFT.set(drift_now["max_abs"])
+            obs_events.emit(
+                "md_step", **rec, drift=drift_now["max_abs"],
+                dt=time.time() - t_step0,
+                extrapolation_error=extra.get("extrapolation_error"),
+            )
             if step == step0:
                 compiles_after_first = backend_compiles_total()
             if traj_fh is not None:
@@ -393,7 +438,7 @@ def run_md_from_file(path: str, resume: str | None = None) -> int:
 
         resume = find_resumable(default_md_autosave_path(cfg, base_dir))
         if resume:
-            print(f"resuming MD from {resume}")
+            logger.warning("resuming MD from %s", resume)
     result = run_md(cfg, base_dir, resume=resume)
     for rec in result["records"]:
         print(
@@ -435,11 +480,16 @@ def main(argv: list[str] | None = None) -> int:
         "--platform", default=None, choices=["cpu", "tpu", "axon"],
         help="JAX platform (same semantics as sirius-scf)",
     )
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="raise log level (-v info, -vv debug)")
     args = p.parse_args(argv)
     if not os.path.isfile(args.input):
         print(f"sirius-md: input file not found: {args.input}",
               file=sys.stderr)
         return 2
+    from sirius_tpu.obs.log import setup as _log_setup
+
+    _log_setup(args.verbose)
     import jax
 
     platform = args.platform

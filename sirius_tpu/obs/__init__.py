@@ -1,0 +1,63 @@
+"""sirius_tpu.obs — unified telemetry: metrics registry, JSONL events,
+structured logging, on-demand jax.profiler capture, and the serve
+/metrics HTTP endpoint.
+
+Quick tour::
+
+    from sirius_tpu import obs
+
+    obs.REGISTRY.counter("scf_iterations_total").inc(job_id="si-0")
+    obs.emit("scf_iteration", it=3, rms=1e-5)     # no-op unless configured
+    obs.configure_events("run/events.jsonl")
+    with obs.job_context("si-0", step=3):
+        obs.get_logger("dft").info("converged")
+
+``disable()`` (or ``control.telemetry = false``) turns metric updates
+into no-ops for overhead-critical benchmarking; the event sink is
+already a no-op unless a path was configured.
+"""
+
+from sirius_tpu.obs.events import (
+    close as close_events,
+    configure as configure_events,
+    configured as events_configured,
+    emit,
+    read_events,
+)
+from sirius_tpu.obs.log import get_logger, job_context, setup as setup_logging
+from sirius_tpu.obs.metrics import (
+    REGISTRY,
+    backend_compiles_this_thread,
+    backend_compiles_total,
+    install_jax_listeners,
+    set_enabled,
+    update_device_memory_gauges,
+)
+from sirius_tpu.obs.trace import CAPTURE
+
+__all__ = [
+    "REGISTRY",
+    "CAPTURE",
+    "emit",
+    "configure_events",
+    "events_configured",
+    "close_events",
+    "read_events",
+    "get_logger",
+    "job_context",
+    "setup_logging",
+    "install_jax_listeners",
+    "backend_compiles_total",
+    "backend_compiles_this_thread",
+    "update_device_memory_gauges",
+    "enable",
+    "disable",
+]
+
+
+def enable() -> None:
+    set_enabled(True)
+
+
+def disable() -> None:
+    set_enabled(False)

@@ -1,0 +1,118 @@
+"""Structured JSONL event sink.
+
+One line per event, append-only, flushed per write so a preempted run
+leaves a readable log. Schema: every record carries ``ts`` (unix
+seconds), ``kind``, and whatever fields the producer passed; ``job_id``
+and ``step`` are injected from the logging context (obs/log.py) when not
+given explicitly, so serve-worker SCF iterations attribute to their job
+without the DFT layer knowing it runs under serve.
+
+Event kinds emitted across the tree:
+
+- ``run_manifest``   — once per run_scf/run_md: deck label, task, shapes
+- ``scf_iteration``  — per SCF iteration: the [16] device scalar record
+  (dft/fused.py) or the host-path equivalents, plus rms/e_total
+- ``scf_done``       — terminal SCF record: converged, iterations, energy
+- ``recovery``       — each ladder rung taken (dft/recovery.py)
+- ``autosave`` / ``checkpoint`` — checkpoint writes with path + iteration
+- ``md_step``        — per MD step: energies, drift, scf_iterations,
+  extrapolation error
+- ``job_transition`` — serve job lifecycle (queued→…→done|failed|aborted)
+- ``trace_capture``  — profiler trace start/stop with the output dir
+
+Unconfigured, ``emit`` is one attribute test — safe on every hot path.
+Configuration is process-wide (module-level) because producers span
+threads; tests configure per-tmpdir and ``close()`` in teardown.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from sirius_tpu.obs import log as _log
+
+_lock = threading.Lock()
+_fh = None
+_path: str | None = None
+
+
+def configure(path: str) -> str:
+    """Open (append) the JSONL sink at ``path``. Returns the path.
+    Reconfiguring to the same path is a no-op; to a new path closes the
+    old sink first."""
+    global _fh, _path
+    with _lock:
+        if _fh is not None and _path == str(path):
+            return _path
+        if _fh is not None:
+            _fh.close()
+        _fh = open(path, "a", encoding="utf-8")
+        _path = str(path)
+        return _path
+
+
+def configured() -> bool:
+    return _fh is not None
+
+
+def path() -> str | None:
+    return _path
+
+
+def close() -> None:
+    global _fh, _path
+    with _lock:
+        if _fh is not None:
+            _fh.close()
+        _fh = None
+        _path = None
+
+
+def emit(kind: str, **fields) -> None:
+    """Append one event. No-op unless configure() was called."""
+    if _fh is None:
+        return
+    rec = {"ts": time.time(), "kind": kind}
+    if "job_id" not in fields:
+        job = _log.current_job_id()
+        if job is not None:
+            rec["job_id"] = job
+    if "step" not in fields:
+        step = _log.current_step()
+        if step is not None:
+            rec["step"] = step
+    rec.update(fields)
+    line = json.dumps(rec, default=_coerce) + "\n"
+    with _lock:
+        if _fh is None:
+            return
+        _fh.write(line)
+        _fh.flush()
+
+
+def _coerce(obj):
+    # numpy / jax scalars and arrays show up in producer payloads
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if fn is not None:
+            try:
+                return fn()
+            except Exception:
+                pass
+    return str(obj)
+
+
+def read_events(path: str, kind: str | None = None) -> list[dict]:
+    """Parse a JSONL event log back (tools/bench_md.py, tests)."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out

@@ -1,0 +1,124 @@
+"""Embedded observability HTTP endpoint for ServeEngine.
+
+Serves, on a daemon ThreadingHTTPServer:
+
+- ``GET /metrics``        — Prometheus text exposition of the registry
+  (device-memory gauges refreshed on scrape, so a scrape is the poll)
+- ``GET /healthz``        — JSON liveness/engine summary; 200 while the
+  engine accepts work, 503 after shutdown
+- ``GET /debug/trace?steps=N[&dir=...]`` — arm a jax.profiler capture of
+  the next N SCF iterations on any slice (obs/trace.py); 202 when armed,
+  409 when a capture is already pending
+- ``GET /debug/trace/status`` — capture state
+
+Bound to 127.0.0.1 by default; ``port=0`` picks an ephemeral port
+(tests, CI) exposed as ``server.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from sirius_tpu.obs import metrics as _metrics
+from sirius_tpu.obs.log import get_logger
+from sirius_tpu.obs.trace import CAPTURE
+
+logger = get_logger("obs.http")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "sirius-obs/1"
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, indent=1) + "\n", "application/json")
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        url = urlparse(self.path)
+        route = url.path.rstrip("/") or "/"
+        try:
+            if route == "/metrics":
+                _metrics.update_device_memory_gauges()
+                self._send(200, _metrics.REGISTRY.render_prometheus(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                health = self.server.health_fn()
+                self._send_json(200 if health.get("ok", False) else 503,
+                                health)
+            elif route == "/debug/trace":
+                q = parse_qs(url.query)
+                steps = int(q.get("steps", ["5"])[0])
+                tdir = q.get("dir", [self.server.default_trace_dir])[0]
+                armed = CAPTURE.request(tdir, steps, force=True)
+                self._send_json(202 if armed else 409,
+                                {"armed": armed, **CAPTURE.status()})
+            elif route == "/debug/trace/status":
+                self._send_json(200, CAPTURE.status())
+            else:
+                self._send_json(404, {"error": f"no route {route}"})
+        except Exception as exc:
+            logger.warning("obs http %s failed: %s", route, exc)
+            try:
+                self._send_json(500, {"error": str(exc)})
+            except Exception:
+                pass
+
+    def log_message(self, format, *args):  # silence per-request stderr spam
+        logger.debug("http %s", format % args)
+
+
+class ObsHttpServer:
+    """Lifecycle wrapper: start() binds and spins a daemon thread,
+    stop() shuts the socket down. health_fn is polled per /healthz."""
+
+    def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
+                 health_fn=None, default_trace_dir: str = "trace_capture"):
+        self._host = host
+        self._requested_port = port
+        self._health_fn = health_fn or (lambda: {"ok": True})
+        self._default_trace_dir = default_trace_dir
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int | None:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> str | None:
+        return f"http://{self._host}:{self.port}" if self._httpd else None
+
+    def start(self) -> "ObsHttpServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self._host, self._requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        httpd.health_fn = self._health_fn
+        httpd.default_trace_dir = self._default_trace_dir
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="obs-http", daemon=True)
+        self._thread.start()
+        logger.info("obs endpoint listening on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None

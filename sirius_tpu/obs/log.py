@@ -1,0 +1,112 @@
+"""Structured logging with job/step context.
+
+Serve slice workers, the MD step loop, and nested SCF runs all used to
+write raw ``print(...)`` lines that interleave arbitrarily under
+concurrency. Here every module grabs a child of the ``sirius_tpu``
+logger and the current job id / step ride along in contextvars, so a
+line like::
+
+    [serve] retrying si-3 after SimulatedKill (attempt 2)
+
+renders as::
+
+    12:03:44 sirius_tpu.serve [job=si-3] retrying after SimulatedKill (attempt 2)
+
+no matter which slice thread emitted it. Quiet by default (NullHandler);
+``setup(verbosity)`` — called from the CLIs' ``-v`` flag or from
+``control.verbosity`` — attaches one stderr handler idempotently.
+Plain ``threading.Thread`` workers start with an *empty* contextvars
+context, so long-lived pools (serve slice workers) must set the context
+explicitly per job — scheduler._run_job wraps each job in
+``job_context(job.id)`` for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import sys
+
+_job_id_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "sirius_job_id", default=None)
+_step_var: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "sirius_step", default=None)
+
+ROOT = "sirius_tpu"
+
+_setup_done = False
+_setup_level = logging.WARNING
+
+
+def current_job_id() -> str | None:
+    return _job_id_var.get()
+
+
+def current_step() -> int | None:
+    return _step_var.get()
+
+
+@contextlib.contextmanager
+def job_context(job_id: str | None = None, step: int | None = None):
+    """Attach job_id/step to every log record and obs event emitted
+    inside the block (threads inherit a copy at start time)."""
+    tok_j = _job_id_var.set(job_id) if job_id is not None else None
+    tok_s = _step_var.set(step) if step is not None else None
+    try:
+        yield
+    finally:
+        if tok_j is not None:
+            _job_id_var.reset(tok_j)
+        if tok_s is not None:
+            _step_var.reset(tok_s)
+
+
+class _ContextFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        job = _job_id_var.get()
+        step = _step_var.get()
+        parts = []
+        if job is not None:
+            parts.append(f"job={job}")
+        if step is not None:
+            parts.append(f"step={step}")
+        record.obs_ctx = f"[{' '.join(parts)}] " if parts else ""
+        return True
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    """Child of the sirius_tpu hierarchy; e.g. get_logger('serve')."""
+    logger = logging.getLogger(f"{ROOT}.{name}" if name else ROOT)
+    return logger
+
+
+def setup(verbosity: int = 0, *, stream=None, force: bool = False) -> None:
+    """Attach the stderr handler once. verbosity 0 → WARNING,
+    1 → INFO, 2+ → DEBUG. Re-calling only ever lowers the threshold
+    (a serve engine at -v must not silence a -vv CLI)."""
+    global _setup_done, _setup_level
+    level = (logging.WARNING if verbosity <= 0
+             else logging.INFO if verbosity == 1 else logging.DEBUG)
+    root = logging.getLogger(ROOT)
+    if _setup_done and not force:
+        if level < _setup_level:
+            _setup_level = level
+            root.setLevel(level)
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(obs_ctx)s%(message)s", datefmt="%H:%M:%S"))
+    handler.addFilter(_ContextFilter())
+    if force:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _setup_done = True
+    _setup_level = level
+
+
+# importing sirius_tpu must never print; callers opt in via setup()
+logging.getLogger(ROOT).addHandler(logging.NullHandler())

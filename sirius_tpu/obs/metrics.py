@@ -1,0 +1,365 @@
+"""Metrics registry: labelled counters, gauges and histograms, plus the
+jax.monitoring backend listeners.
+
+The reference reports work through rt_graph timer trees printed at
+finalize (core/rt_graph.hpp) and self-reported counters
+(davidson.hpp:834); a serving engine needs the same numbers *while the
+process runs*. This module is the shared registry every layer publishes
+into: dft/scf.py (iteration counts, residuals), dft/recovery.py (ladder
+rungs), serve/* (queue depth, job latency, cache hits, XLA compiles),
+md/driver.py (step counters, drift). Exporters render it as Prometheus
+text (obs/http.py) or embed ``REGISTRY.snapshot()`` into bench JSON.
+
+Everything is thread-safe and cheap on the hot path: one dict lookup plus
+a float add under a lock per update. ``sirius_tpu.obs.disable()`` turns
+every update into a no-op for overhead-critical benchmarking.
+
+The XLA listener generalizes the serve/cache.py compile counter: one
+jax.monitoring registration feeds backend-compile counts (kept per-thread
+for the cache-hit assertions in tests/test_serve.py) AND trace/lowering
+duration histograms, so compile-time regressions are visible in the same
+scrape as the throughput numbers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# ---------------------------------------------------------------------------
+# registry
+
+# default histogram buckets: latencies from sub-ms jit dispatches to
+# multi-minute cold SCF jobs
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 600.0,
+)
+
+_enabled = True
+
+
+def set_enabled(flag: bool) -> None:
+    """Process-wide kill switch (control.telemetry = false)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _Family:
+    """One named metric family; children are keyed by their label set."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def _child(self, labels: dict):
+        key = _labelkey(labels)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._new_child()
+                self._children[key] = c
+            return c
+
+    def labelsets(self) -> list[tuple]:
+        with self._lock:
+            return list(self._children)
+
+
+class Counter(_Family):
+    """Monotonically increasing value per label set."""
+
+    kind = "counter"
+
+    def _new_child(self):
+        return [0.0]
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not _enabled:
+            return
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def value(self, **labels) -> float:
+        return self._child(labels)[0]
+
+
+class Gauge(_Family):
+    """Last-written value per label set."""
+
+    kind = "gauge"
+
+    def _new_child(self):
+        return [0.0]
+
+    def set(self, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        c = self._child(labels)
+        with self._lock:
+            c[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not _enabled:
+            return
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def max(self, value: float, **labels) -> None:
+        """High-water-mark update (queue depth peaks)."""
+        if not _enabled:
+            return
+        c = self._child(labels)
+        with self._lock:
+            if value > c[0]:
+                c[0] = float(value)
+
+    def value(self, **labels) -> float:
+        return self._child(labels)[0]
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram (Prometheus semantics) per label set."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _new_child(self):
+        # [per-bucket counts..., +Inf count], sum, count
+        return {"counts": [0] * (len(self.buckets) + 1),
+                "sum": 0.0, "n": 0}
+
+    def observe(self, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        c = self._child(labels)
+        i = bisect.bisect_left(self.buckets, float(value))
+        with self._lock:
+            c["counts"][i] += 1
+            c["sum"] += float(value)
+            c["n"] += 1
+
+    def child_stats(self, **labels) -> dict:
+        c = self._child(labels)
+        with self._lock:
+            return {"sum": c["sum"], "count": c["n"],
+                    "buckets": dict(zip(
+                        [*self.buckets, float("inf")], c["counts"]))}
+
+
+class MetricsRegistry:
+    """Named families; idempotent creation so producers never coordinate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = cls(name, help, **kw)
+                self._families[name] = fam
+            elif not isinstance(fam, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as {fam.kind}")
+            return fam
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def families(self) -> list[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def reset(self) -> None:
+        """Drop every family (tests only)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- exporters --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-friendly dump: {name: {type, help, samples: [...]}}.
+        Histogram samples carry sum/count/cumulative buckets."""
+        out = {}
+        for fam in self.families():
+            samples = []
+            for key in fam.labelsets():
+                labels = dict(key)
+                if fam.kind == "histogram":
+                    samples.append({"labels": labels,
+                                    **fam.child_stats(**labels)})
+                else:
+                    samples.append({"labels": labels,
+                                    "value": fam.value(**labels)})
+            out[fam.name] = {"type": fam.kind, "help": fam.help,
+                             "samples": samples}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (text/plain; version=0.0.4)."""
+
+        def fmt_labels(labels: dict, extra: dict | None = None) -> str:
+            items = {**labels, **(extra or {})}
+            if not items:
+                return ""
+            body = ",".join(
+                f'{k}="{_escape(str(v))}"' for k, v in sorted(items.items()))
+            return "{" + body + "}"
+
+        def _escape(s: str) -> str:
+            return s.replace("\\", "\\\\").replace('"', '\\"').replace(
+                "\n", "\\n")
+
+        def fmt_val(v: float) -> str:
+            if v == float("inf"):
+                return "+Inf"
+            f = float(v)
+            return repr(int(f)) if f == int(f) else repr(f)
+
+        lines = []
+        for fam in sorted(self.families(), key=lambda f: f.name):
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key in fam.labelsets():
+                labels = dict(key)
+                if fam.kind == "histogram":
+                    st = fam.child_stats(**labels)
+                    acc = 0
+                    for le, n in st["buckets"].items():
+                        acc += n
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{fmt_labels(labels, {'le': fmt_val(le)})}"
+                            f" {acc}")
+                    lines.append(
+                        f"{fam.name}_sum{fmt_labels(labels)}"
+                        f" {repr(st['sum'])}")
+                    lines.append(
+                        f"{fam.name}_count{fmt_labels(labels)}"
+                        f" {st['count']}")
+                else:
+                    lines.append(
+                        f"{fam.name}{fmt_labels(labels)}"
+                        f" {fmt_val(fam.value(**labels))}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring backend listeners (generalized from serve/cache.py)
+
+# every XLA backend compile / jaxpr trace / MLIR lowering fires one of
+# these duration events on the calling thread (jax/_src/dispatch.py)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+JAXPR_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+LOWERING_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+_compile_lock = threading.Lock()
+_compiles_total = 0
+_compiles_tls = threading.local()
+_listener_installed = False
+
+
+def _on_duration_event(event: str, *args, **kwargs) -> None:
+    global _compiles_total
+    # the duration is the first positional arg in every jax version that
+    # ships these events; be tolerant of signature drift
+    dt = float(args[0]) if args else 0.0
+    if event == BACKEND_COMPILE_EVENT:
+        with _compile_lock:
+            _compiles_total += 1
+        _compiles_tls.count = getattr(_compiles_tls, "count", 0) + 1
+        REGISTRY.counter(
+            "jax_backend_compiles_total",
+            "XLA backend compilations").inc()
+        REGISTRY.histogram(
+            "jax_backend_compile_seconds",
+            "XLA backend compile durations").observe(dt)
+    elif event == JAXPR_TRACE_EVENT:
+        REGISTRY.histogram(
+            "jax_trace_seconds", "jaxpr trace durations").observe(dt)
+    elif event == LOWERING_EVENT:
+        REGISTRY.histogram(
+            "jax_lowering_seconds",
+            "jaxpr-to-MLIR lowering durations").observe(dt)
+
+
+def install_jax_listeners() -> bool:
+    """Register the XLA compile/trace/lowering listener (idempotent).
+    Returns False when this jax build has no monitoring hooks."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration_event)
+    except (ImportError, AttributeError):
+        return False
+    _listener_installed = True
+    return True
+
+
+def backend_compiles_total() -> int:
+    """Process-wide XLA backend compile count (monotone across engine
+    lifetimes: the listener registration is global and permanent)."""
+    with _compile_lock:
+        return _compiles_total
+
+
+def backend_compiles_this_thread() -> int:
+    return getattr(_compiles_tls, "count", 0)
+
+
+def update_device_memory_gauges() -> None:
+    """Refresh per-device memory gauges from device.memory_stats().
+    Backends without memory introspection (CPU) report 0 so the series
+    still exists for dashboards that alert on its absence."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return
+    g = REGISTRY.gauge(
+        "jax_device_memory_bytes",
+        "device memory from device.memory_stats() (0 = not reported)")
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        dev = f"{d.platform}:{d.id}"
+        if not stats:
+            g.set(0.0, device=dev, kind="bytes_in_use")
+            continue
+        for kind in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if kind in stats:
+                g.set(float(stats[kind]), device=dev, kind=kind)

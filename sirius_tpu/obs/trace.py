@@ -1,0 +1,134 @@
+"""On-demand jax.profiler trace capture around SCF iterations.
+
+A trace of the *whole* run is useless for long serve processes and
+thousand-step MD — you want "the next N SCF iterations, starting now".
+This singleton arms a capture (from ``control.trace_capture`` at run_scf
+entry, or live from the serve ``/debug/trace?steps=N`` endpoint); the
+SCF loop calls ``tick()`` at the top of every iteration and ``finish()``
+when it leaves the loop. tick() starts jax.profiler.trace on the first
+iteration after arming and stops it after N ticks, writing a
+TensorBoard-readable directory (plugins/profile/<ts>/ with .xplane.pb).
+
+The SCF loop has several ``continue`` paths (recovery rollback, band
+rescue), which is why bracketing start/stop around the loop body would
+leak an open trace; counting at the loop head plus an unconditional
+finish() after the loop is robust to all of them. A completed-dirs set
+keeps ``control.trace_capture`` from re-arming on every MD step's
+run_scf call — one trace per requested directory unless force=True
+(the serve endpoint forces, with a fresh subdirectory per request).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from sirius_tpu.obs import events
+from sirius_tpu.obs.log import get_logger
+
+logger = get_logger("obs.trace")
+
+
+class TraceCapture:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed_dir: str | None = None
+        self._remaining = 0
+        self._active = False
+        self._done_dirs: set[str] = set()
+
+    def request(self, trace_dir: str, steps: int = 5, *,
+                force: bool = False) -> bool:
+        """Arm a capture of the next ``steps`` SCF iterations into
+        ``trace_dir``. Returns False when already captured (and not
+        forced) or a capture is in flight."""
+        trace_dir = str(trace_dir)
+        with self._lock:
+            if self._active or self._armed_dir is not None:
+                return False
+            if trace_dir in self._done_dirs and not force:
+                return False
+            self._armed_dir = trace_dir
+            self._remaining = max(1, int(steps))
+        logger.info("trace capture armed: %d iterations -> %s",
+                    self._remaining, trace_dir)
+        return True
+
+    def tick(self) -> None:
+        """Call at the top of each SCF iteration."""
+        with self._lock:
+            if self._armed_dir is not None and not self._active:
+                target = self._armed_dir
+                start = True
+            elif self._active:
+                self._remaining -= 1
+                if self._remaining <= 0:
+                    return self._stop_locked()
+                return
+            else:
+                return
+        if start:
+            self._start(target)
+
+    def finish(self) -> None:
+        """Call after the SCF loop exits (converged, aborted, or
+        exhausted) — closes a capture shorter than requested."""
+        with self._lock:
+            if self._active:
+                self._stop_locked()
+            self._armed_dir = None
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"active": self._active,
+                    "armed_dir": self._armed_dir,
+                    "remaining": self._remaining,
+                    "completed": sorted(self._done_dirs)}
+
+    # -- internals (lock handling: _start runs unlocked because
+    #    jax.profiler.start_trace can itself compile) ------------------
+
+    def _start(self, trace_dir: str) -> None:
+        import os
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(trace_dir)
+        except Exception as exc:  # profiler unavailable on some builds
+            logger.warning("trace capture failed to start: %s", exc)
+            with self._lock:
+                self._armed_dir = None
+                self._remaining = 0
+            return
+        with self._lock:
+            self._active = True
+        events.emit("trace_capture", phase="start", trace_dir=trace_dir,
+                    steps=self._remaining)
+
+    def _stop_locked(self) -> None:
+        # called with self._lock held
+        trace_dir = self._armed_dir
+        self._active = False
+        self._armed_dir = None
+        self._remaining = 0
+        if trace_dir is not None:
+            self._done_dirs.add(trace_dir)
+        def _stop():
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                logger.warning("trace capture failed to stop: %s", exc)
+                return
+            logger.info("trace capture written: %s", trace_dir)
+            events.emit("trace_capture", phase="stop", trace_dir=trace_dir,
+                        ts_stop=time.time())
+        # release before touching the profiler: stop_trace flushes to disk
+        self._lock.release()
+        try:
+            _stop()
+        finally:
+            self._lock.acquire()
+
+
+CAPTURE = TraceCapture()

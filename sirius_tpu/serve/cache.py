@@ -13,10 +13,13 @@ Two levels:
   in the bucket — its tables are program *inputs*, so reuse is exact.
 
 Hit/miss counters are exported through utils/profiler.py (thread-local,
-so each job's result reports its own) and aggregated on the cache object
-(cross-thread, what the engine's stats report). A jax.monitoring listener
-counts real XLA backend compiles per thread, which is what "a cache hit
-means zero new executables" is asserted against in tests/test_serve.py.
+so each job's result reports its own), aggregated on the cache object
+(cross-thread, what the engine's stats report), and mirrored into the
+obs metrics registry for the /metrics endpoint. The jax.monitoring
+backend-compile listener that "a cache hit means zero new executables"
+is asserted against (tests/test_serve.py) now lives in obs/metrics.py,
+where it also records trace/lowering duration histograms; the names
+below stay as re-exports for existing callers.
 """
 
 from __future__ import annotations
@@ -24,49 +27,13 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.metrics import (  # noqa: F401  (back-compat re-exports)
+    backend_compiles_this_thread,
+    backend_compiles_total,
+    install_jax_listeners as install_compile_listener,
+)
 from sirius_tpu.utils.profiler import counters
-
-# every XLA backend compile fires this duration event on the calling
-# thread (jax/_src/dispatch.py BACKEND_COMPILE_EVENT)
-_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-
-_compile_lock = threading.Lock()
-_compiles_total = 0
-_compiles_tls = threading.local()
-_listener_installed = False
-
-
-def _on_event(event: str, *args, **kwargs) -> None:
-    global _compiles_total
-    if event != _BACKEND_COMPILE_EVENT:
-        return
-    with _compile_lock:
-        _compiles_total += 1
-    _compiles_tls.count = getattr(_compiles_tls, "count", 0) + 1
-
-
-def install_compile_listener() -> bool:
-    """Register the XLA compile counter (idempotent). Returns False when
-    this jax build has no monitoring hooks."""
-    global _listener_installed
-    if _listener_installed:
-        return True
-    try:
-        from jax import monitoring
-        monitoring.register_event_duration_secs_listener(_on_event)
-    except (ImportError, AttributeError):
-        return False
-    _listener_installed = True
-    return True
-
-
-def backend_compiles_total() -> int:
-    with _compile_lock:
-        return _compiles_total
-
-
-def backend_compiles_this_thread() -> int:
-    return getattr(_compiles_tls, "count", 0)
 
 
 def bucket_key(cfg, ctx) -> tuple:
@@ -117,6 +84,10 @@ class ExecutableCache:
         self.job_hits = 0      # job/bucket-level (note_job)
         self.job_misses = 0
         install_compile_listener()
+        self._m_exec = obs_metrics.REGISTRY.counter(
+            "serve_cache_exec_total", "executable cache lookups")
+        self._m_job = obs_metrics.REGISTRY.counter(
+            "serve_cache_jobs_total", "job-level bucket lookups")
 
     # -- executable level ------------------------------------------------
 
@@ -128,9 +99,11 @@ class ExecutableCache:
                 self._exe.move_to_end(sig)
                 self.hits += 1
                 counters["serve.cache.exec_hit"] += 1
+                self._m_exec.inc(outcome="hit")
                 return self._exe[sig]
             self.misses += 1
             counters["serve.cache.exec_miss"] += 1
+            self._m_exec.inc(outcome="miss")
             exe = builder()
             self._exe[sig] = exe
             while len(self._exe) > self.capacity:
@@ -149,9 +122,11 @@ class ExecutableCache:
             if warm:
                 self.job_hits += 1
                 counters["serve.cache.job_hit"] += 1
+                self._m_job.inc(outcome="hit")
             else:
                 self.job_misses += 1
                 counters["serve.cache.job_miss"] += 1
+                self._m_job.inc(outcome="miss")
             return warm
 
     def stats(self) -> dict:

@@ -13,6 +13,14 @@ Library use::
 CLI use: ``sirius-serve deck1.json deck2.json ... [--slices N]`` runs the
 decks to completion and prints a JSON stats report (the same shape
 tools/loadgen.py writes to SERVE_BENCH.json).
+
+Observability: ``metrics_port`` starts the obs HTTP endpoint
+(``/metrics`` Prometheus text, ``/healthz`` JSON, ``/debug/trace`` to arm
+a jax.profiler capture — obs/http.py) for the engine's lifetime, and
+``events_path`` opens the JSONL event sink so every job transition and
+SCF iteration is logged. ``metrics_snapshot()`` is the pull-style
+equivalent for batch runs: the full registry plus engine stats as one
+JSON-friendly dict (what loadgen embeds into SERVE_BENCH.json).
 """
 
 from __future__ import annotations
@@ -22,6 +30,7 @@ import json
 import sys
 import time
 
+from sirius_tpu import obs
 from sirius_tpu.serve.cache import ExecutableCache
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
 from sirius_tpu.serve.scheduler import SliceScheduler
@@ -38,7 +47,8 @@ class ServeEngine:
     def __init__(self, num_slices: int = 1, devices=None,
                  cache_capacity: int = 32, autosave_every: int = 3,
                  autosave_keep: int = 2, workdir: str = ".",
-                 verbose: bool = False):
+                 verbose: bool = False, metrics_port: int | None = None,
+                 events_path: str | None = None):
         self.queue = JobQueue()
         self.cache = ExecutableCache(capacity=cache_capacity)
         self.workdir = workdir
@@ -49,6 +59,18 @@ class ServeEngine:
         )
         self._t0: float | None = None
         self._submitted: list[Job] = []
+        self._shutdown = False
+        self._obs_server = None
+        if events_path:
+            obs.configure_events(events_path)
+        if metrics_port is not None:
+            import os
+
+            from sirius_tpu.obs.http import ObsHttpServer
+            self._obs_server = ObsHttpServer(
+                port=metrics_port, health_fn=self._health,
+                default_trace_dir=os.path.join(workdir, "trace_capture"),
+            )
 
     @property
     def num_slices(self) -> int:
@@ -56,7 +78,26 @@ class ServeEngine:
 
     def start(self) -> None:
         self._t0 = time.time()
+        if self._obs_server is not None:
+            self._obs_server.start()
         self.scheduler.start()
+
+    @property
+    def metrics_url(self) -> str | None:
+        """Base URL of the obs endpoint (None when metrics_port unset)."""
+        return self._obs_server.url if self._obs_server else None
+
+    def _health(self) -> dict:
+        terminal = (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED)
+        return {
+            "ok": not self._shutdown,
+            "num_slices": self.num_slices,
+            "queue_depth": len(self.queue),
+            "jobs_submitted": len(self._submitted),
+            "jobs_in_flight": sum(
+                j.status not in terminal for j in self._submitted),
+            "uptime_s": (time.time() - self._t0) if self._t0 else 0.0,
+        }
 
     def submit(self, deck: dict, job_id: str | None = None,
                priority: int = 0, deadline: float | None = None,
@@ -80,11 +121,14 @@ class ServeEngine:
         return True
 
     def shutdown(self, wait: bool = True, cleanup: bool = True) -> None:
+        self._shutdown = True
         self.queue.close()
         if wait:
             self.scheduler.join(timeout=60.0)
         if cleanup:
             self.scheduler.cleanup_autosaves(self._submitted)
+        if self._obs_server is not None:
+            self._obs_server.stop()
 
     def stats(self) -> dict:
         done = [j for j in self._submitted if j.status == JobStatus.DONE]
@@ -106,6 +150,18 @@ class ServeEngine:
             "retries_total": sum(j.attempts - 1 for j in self._submitted),
         }
 
+    def metrics_snapshot(self) -> dict:
+        """Full observability snapshot for batch runs: engine stats,
+        compile counts, queue high-water, and the metrics registry
+        (histograms with cumulative buckets) as JSON-friendly data."""
+        obs.update_device_memory_gauges()
+        return {
+            "stats": self.stats(),
+            "backend_compiles_total": obs.backend_compiles_total(),
+            "queue_depth_high_water": self.queue.high_water,
+            "registry": obs.REGISTRY.snapshot(),
+        }
+
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
@@ -125,7 +181,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--stats_out", default=None,
                    help="also write the stats JSON to this path")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"])
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve /metrics + /healthz on this port "
+                        "(0 = ephemeral; off when omitted)")
+    p.add_argument("--events", default=None,
+                   help="append JSONL observability events to this file")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="raise log level (-v info, -vv debug)")
     args = p.parse_args(argv)
+
+    obs.setup_logging(args.verbose)
 
     import os
 
@@ -142,8 +207,13 @@ def main(argv: list[str] | None = None) -> int:
             "axon" if args.platform == "tpu" else args.platform,
         )
 
-    eng = ServeEngine(num_slices=args.slices, verbose=True)
+    eng = ServeEngine(num_slices=args.slices, verbose=True,
+                      metrics_port=args.metrics_port,
+                      events_path=args.events)
     eng.start()
+    if eng.metrics_url:
+        print(f"sirius-serve: metrics at {eng.metrics_url}/metrics",
+              file=sys.stderr)
     for rep in range(args.repeat):
         for path in args.decks:
             with open(path) as f:
@@ -156,8 +226,10 @@ def main(argv: list[str] | None = None) -> int:
                 base_dir=os.path.dirname(os.path.abspath(path)) or ".",
             )
     ok = eng.wait_all(timeout=args.timeout)
+    stats_obs = eng.metrics_snapshot()
     eng.shutdown(wait=True)
     stats = eng.stats()
+    stats["obs"] = {k: v for k, v in stats_obs.items() if k != "stats"}
     stats["jobs"] = [j.to_dict() for j in eng._submitted]
     print(json.dumps(stats, indent=2, default=float))
     if args.stats_out:

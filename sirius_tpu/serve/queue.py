@@ -17,6 +17,20 @@ import itertools
 import threading
 import time
 
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+
+_TRANSITIONS = obs_metrics.REGISTRY.counter(
+    "serve_job_transitions_total", "job lifecycle transitions by status")
+_STATE_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serve_job_state_seconds", "time spent in each job state")
+_LATENCY = obs_metrics.REGISTRY.histogram(
+    "serve_job_latency_seconds", "submit-to-terminal job latency")
+_DEPTH = obs_metrics.REGISTRY.gauge(
+    "serve_queue_depth", "jobs waiting in the queue")
+_DEPTH_HW = obs_metrics.REGISTRY.gauge(
+    "serve_queue_depth_high_water", "max queue depth seen this process")
+
 
 class JobStatus:
     QUEUED = "queued"
@@ -52,10 +66,19 @@ class Job:
         self._done = threading.Event()
 
     def _transition(self, status: str, detail: str = "") -> None:
+        now = time.time()
+        if self.events:
+            prev_t, prev_status, _ = self.events[-1]
+            _STATE_SECONDS.observe(now - prev_t, state=prev_status)
         self.status = status
-        self.events.append((time.time(), status, detail))
+        self.events.append((now, status, detail))
+        _TRANSITIONS.inc(status=status)
+        obs_events.emit("job_transition", job_id=self.id, status=status,
+                        detail=detail, attempt=self.attempts)
         if status in (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED):
-            self.finished_at = time.time()
+            self.finished_at = now
+            if self.submitted_at is not None:
+                _LATENCY.observe(now - self.submitted_at, outcome=status)
             self._done.set()
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -95,6 +118,14 @@ class JobQueue:
         self._seq = itertools.count()
         self._closed = False
         self.jobs: dict[str, Job] = {}
+        self.high_water = 0
+
+    def _depth_changed_locked(self) -> None:
+        depth = len(self._heap)
+        if depth > self.high_water:
+            self.high_water = depth
+        _DEPTH.set(depth)
+        _DEPTH_HW.max(depth)
 
     def submit(self, job: Job) -> Job:
         with self._not_empty:
@@ -109,6 +140,7 @@ class JobQueue:
                 next(self._seq),
                 job,
             ))
+            self._depth_changed_locked()
             self._not_empty.notify()
         return job
 
@@ -125,6 +157,7 @@ class JobQueue:
                 next(self._seq),
                 job,
             ))
+            self._depth_changed_locked()
             self._not_empty.notify()
 
     def pop(self, timeout: float | None = None) -> Job | None:
@@ -135,6 +168,7 @@ class JobQueue:
             while True:
                 while self._heap:
                     _, _, _, job = heapq.heappop(self._heap)
+                    self._depth_changed_locked()
                     if (job.deadline is not None
                             and time.time() > job.deadline):
                         job._transition(

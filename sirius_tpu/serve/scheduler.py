@@ -21,14 +21,26 @@ Failure classification:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
 import numpy as np
 
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger, job_context
 from sirius_tpu.serve import cache as cache_mod
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
 from sirius_tpu.utils.profiler import counters
+
+logger = get_logger("serve")
+
+_RUN_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serve_job_run_seconds", "per-attempt SCF wall time by bucket warmth")
+_RETRIES = obs_metrics.REGISTRY.counter(
+    "serve_job_retries_total", "transient-failure retries")
+_FAILURES = obs_metrics.REGISTRY.counter(
+    "serve_job_failures_total", "terminal job failures")
 
 # SimulationContext building for synthetic decks monkeypatches
 # UnitCell.from_config (testing.py idiom); serialize every context build
@@ -136,6 +148,14 @@ class SliceScheduler:
             self._run_job(job, idx, devs)
 
     def _run_job(self, job: Job, slice_idx: int, devs) -> None:
+        job.attempts += 1
+        # every log line and obs event inside the attempt carries job.id
+        with job_context(job.id):
+            self._run_job_inner(job, slice_idx, devs)
+
+    def _run_job_inner(self, job: Job, slice_idx: int, devs) -> None:
+        import time as _time
+
         import jax
 
         from sirius_tpu.config.schema import load_config
@@ -145,7 +165,6 @@ class SliceScheduler:
         from sirius_tpu.io.upf import UpfParseError
         from sirius_tpu.utils.faults import SimulatedKill
 
-        job.attempts += 1
         cfg = None
         try:
             cfg = load_config(dict(job.deck))
@@ -167,12 +186,16 @@ class SliceScheduler:
             if job.started_at is None:
                 job.started_at = job.events[-1][0]
             compiles0 = cache_mod.backend_compiles_this_thread()
+            t_run0 = _time.time()
             with jax.default_device(devs[0]):
                 result = run_scf(
                     cfg, base_dir=job.base_dir, ctx=ctx,
                     exec_cache=self.cache, devices=devs,
                     resume=job.resume_path,
                 )
+            _RUN_SECONDS.observe(_time.time() - t_run0,
+                                 bucket="warm" if warm else "cold",
+                                 slice=slice_idx)
             compiled = cache_mod.backend_compiles_this_thread() - compiles0
             counters["serve.backend_compiles"] += compiled
             result["serve"] = {
@@ -213,6 +236,7 @@ class SliceScheduler:
         from sirius_tpu.io.checkpoint import find_resumable
 
         counters["serve.retries"] += 1
+        _RETRIES.inc(job_id=job.id)
         if job.attempts > job.max_retries:
             self._fail(job, f"{detail} (retries exhausted)")
             return
@@ -221,15 +245,17 @@ class SliceScheduler:
                 cfg, job.base_dir)
             job.resume_path = find_resumable(
                 auto, keep=int(cfg.control.autosave_keep))
-        if self.verbose:
-            print(f"[serve] retrying {job.id}: {detail} "
-                  f"(resume={job.resume_path})", flush=True)
+        logger.log(
+            logging.INFO if self.verbose else logging.DEBUG,
+            "retrying %s: %s (resume=%s)", job.id, detail, job.resume_path)
         self.queue.requeue(job, detail)
 
     def _fail(self, job: Job, detail: str, permanent: bool = False) -> None:
         job.error = detail
         job.permanent = permanent
         counters["serve.failures"] += 1
+        _FAILURES.inc(permanent=str(permanent).lower())
+        logger.info("job %s failed: %s", job.id, detail)
         job._transition(JobStatus.FAILED, detail)
 
     def cleanup_autosaves(self, jobs) -> None:

@@ -14,23 +14,63 @@ or double-count work; ``collect()`` merges a snapshot across every thread
 that has recorded anything. The per-thread views keep the historical
 single-threaded semantics: ``reset_timers()`` / ``timer_report()`` /
 ``dict(counters)`` inside a job see only that job's numbers.
+
+Registry lifetime: entries are keyed by a per-state uid (not
+``thread.ident``, which the OS reuses after a thread dies — a recycled
+ident would clobber a live worker's state) and hold only a weakref to
+their thread. When a thread dies its numbers are folded into a single
+``_retired`` aggregate and the entry is dropped, so a long-lived serve
+process does not accumulate one registry entry per finished worker while
+``collect()`` totals still include every thread that ever recorded.
+
+Spans double as the backend for the obs metrics registry: each completed
+``profile()`` span also lands in the ``span_seconds`` histogram
+(labelled by span path), so the /metrics endpoint exposes the same
+timer tree Prometheus-side.
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
+import weakref
 from collections import defaultdict
 from collections.abc import MutableMapping
+
+from sirius_tpu.obs import metrics as _obs_metrics
 
 _tls = threading.local()
 
 # Registry of every thread's (timings, counters) dicts so collect() can
 # produce a merged snapshot. Guarded by _registry_lock; entries are keyed
-# by thread ident and carry the thread name for attribution.
+# by a unique state uid and carry a weakref to their owning thread so
+# dead workers can be pruned into the _retired aggregate.
 _registry_lock = threading.Lock()
 _registry: dict[int, dict] = {}
+_uid = itertools.count()
+_retired = {
+    "timings": defaultdict(list),
+    "counters": defaultdict(float),
+    "threads": 0,
+}
+
+
+def _prune_dead_locked() -> None:
+    """Fold states of dead threads into _retired (lock must be held)."""
+    dead = []
+    for uid, state in _registry.items():
+        t = state["thread"]()
+        if t is None or not t.is_alive():
+            dead.append(uid)
+    for uid in dead:
+        state = _registry.pop(uid)
+        for k, v in state["timings"].items():
+            _retired["timings"][k].extend(v)
+        for k, v in state["counters"].items():
+            _retired["counters"][k] += v
+        _retired["threads"] += 1
 
 
 def _local() -> dict:
@@ -40,13 +80,15 @@ def _local() -> dict:
         t = threading.current_thread()
         state = {
             "name": t.name,
+            "thread": weakref.ref(t),
             "stack": [],
             "timings": defaultdict(list),
             "counters": defaultdict(float),
         }
         _tls.state = state
         with _registry_lock:
-            _registry[t.ident] = state
+            _prune_dead_locked()
+            _registry[next(_uid)] = state
     return state
 
 
@@ -97,13 +139,20 @@ def profile(name: str):
     try:
         yield
     finally:
-        state["timings"][full].append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        state["timings"][full].append(dt)
         stack.pop()
+        _obs_metrics.REGISTRY.histogram(
+            "span_seconds", "host-orchestrated profiler spans").observe(
+                dt, span=full)
 
 
 def add_time(name: str, dt: float) -> None:
     """Record an externally-measured span (same registry as profile())."""
     _local()["timings"][name].append(dt)
+    _obs_metrics.REGISTRY.histogram(
+        "span_seconds", "host-orchestrated profiler spans").observe(
+            dt, span=name)
 
 
 def reset_timers() -> None:
@@ -111,6 +160,21 @@ def reset_timers() -> None:
     state = _local()
     state["timings"].clear()
     state["counters"].clear()
+
+
+def registry_size() -> int:
+    """Live (non-retired) registry entries — one per thread that has
+    recorded and not yet been pruned."""
+    with _registry_lock:
+        return len(_registry)
+
+
+def prune_dead_threads() -> int:
+    """Explicitly fold dead threads into the retired aggregate.
+    Returns the number of live entries remaining."""
+    with _registry_lock:
+        _prune_dead_locked()
+        return len(_registry)
 
 
 def _report(timings: dict[str, list[float]]) -> dict:
@@ -137,10 +201,14 @@ def collect() -> dict:
 
     Returns ``{"counters": summed, "timers": merged_report,
     "threads": {name: report}}``. Counter values are summed across
-    threads; timing samples for the same span name are concatenated
-    before the report statistics are computed.
+    threads (including threads that have since died — their totals live
+    on in the retired aggregate); timing samples for the same span name
+    are concatenated before the report statistics are computed. Dead
+    threads no longer appear individually under ``"threads"``; their
+    merged numbers show up as ``"_retired"`` when non-empty.
     """
     with _registry_lock:
+        _prune_dead_locked()
         states = [
             {
                 "name": s["name"],
@@ -149,6 +217,10 @@ def collect() -> dict:
             }
             for s in _registry.values()
         ]
+        retired = {
+            "timings": {k: list(v) for k, v in _retired["timings"].items()},
+            "counters": dict(_retired["counters"]),
+        }
     merged_counters: dict[str, float] = defaultdict(float)
     merged_timings: dict[str, list[float]] = defaultdict(list)
     per_thread: dict[str, dict] = {}
@@ -159,6 +231,12 @@ def collect() -> dict:
             merged_timings[k].extend(v)
         if s["timings"] or s["counters"]:
             per_thread[s["name"]] = _report(s["timings"])
+    for k, v in retired["counters"].items():
+        merged_counters[k] += v
+    for k, v in retired["timings"].items():
+        merged_timings[k].extend(v)
+    if retired["timings"] or retired["counters"]:
+        per_thread["_retired"] = _report(retired["timings"])
     return {
         "counters": dict(merged_counters),
         "timers": _report(merged_timings),

@@ -1,0 +1,349 @@
+"""Observability layer (sirius_tpu/obs): metrics registry semantics and
+Prometheus rendering, JSONL event exactly-once guarantees through a real
+SCF run, the ServeEngine /metrics + /healthz endpoint, trace capture, and
+the serve stats edge cases (ISSUE 6 satellites)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sirius_tpu import obs
+from sirius_tpu.obs.metrics import MetricsRegistry
+from sirius_tpu.serve.engine import ServeEngine, _percentile
+
+
+@pytest.fixture(autouse=True)
+def _close_events():
+    # the event sink is process-global; never leak a configured sink (or
+    # a disabled registry) into neighbouring tests
+    yield
+    obs.close_events()
+    obs.enable()
+    obs.CAPTURE.finish()  # disarm any capture a test left pending
+
+
+def tiny_deck(**control) -> dict:
+    deck = {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": [1, 1, 1],
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": 15,
+            "density_tol": 1e-7,
+            "energy_tol": 1e-8,
+        },
+        "control": {"ngk_pad_quantum": 16, **control},
+        "synthetic": {"ultrasoft": True},
+    }
+    return deck
+
+
+def run_tiny_scf(base_dir, **control):
+    from sirius_tpu.config.schema import load_config
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.serve.scheduler import build_job_context
+
+    cfg = load_config(tiny_deck(**control))
+    ctx = build_job_context(cfg, str(base_dir))
+    return run_scf(cfg, base_dir=str(base_dir), ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5, job="a")
+    assert c.value() == 1.0
+    assert c.value(job="a") == 2.5
+
+    g = reg.gauge("g")
+    g.set(4.0, slice=0)
+    g.max(2.0, slice=0)  # high-water never moves down
+    assert g.value(slice=0) == 4.0
+    g.max(9.0, slice=0)
+    assert g.value(slice=0) == 9.0
+
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    st = h.child_stats()
+    assert st["count"] == 4
+    assert st["sum"] == pytest.approx(55.55)
+    assert st["buckets"][0.1] == 1
+    assert st["buckets"][float("inf")] == 1
+
+    with pytest.raises(TypeError):
+        reg.gauge("c_total")  # name already taken by a counter
+
+
+def test_prometheus_rendering_format():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs").inc(3, state="done")
+    reg.histogram("lat_seconds", buckets=(1.0, 5.0)).observe(2.0, kind="x")
+    text = reg.render_prometheus()
+    assert '# TYPE jobs_total counter' in text
+    assert 'jobs_total{state="done"} 3' in text
+    # cumulative buckets: le="5.0" includes the le="1.0" count
+    assert 'lat_seconds_bucket{kind="x",le="1"} 0' in text
+    assert 'lat_seconds_bucket{kind="x",le="5"} 1' in text
+    assert 'lat_seconds_bucket{kind="x",le="+Inf"} 1' in text
+    assert 'lat_seconds_count{kind="x"} 1' in text
+    # snapshot mirrors the same data as JSON
+    snap = reg.snapshot()
+    assert snap["jobs_total"]["samples"][0]["value"] == 3
+
+
+def test_registry_disable_is_a_noop_switch():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total")
+    c.inc()
+    obs.disable()
+    try:
+        c.inc()
+        assert c.value() == 1.0
+    finally:
+        obs.enable()
+    c.inc()
+    assert c.value() == 2.0
+
+
+# ---------------------------------------------------------------------------
+# JSONL events through a real SCF run (acceptance: exactly once)
+
+
+def test_scf_events_exactly_once_and_trace_capture(tmp_path):
+    res = run_tiny_scf(
+        tmp_path,
+        events_path="events.jsonl",
+        trace_capture="tracedir",
+        trace_capture_steps=2,
+    )
+    obs.close_events()
+    evs = obs.read_events(str(tmp_path / "events.jsonl"))
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("run_manifest") == 1
+    assert kinds.count("scf_done") == 1
+    # one scf_iteration record per iteration the run reports, exactly
+    iters = [e for e in evs if e["kind"] == "scf_iteration"]
+    assert len(iters) == res["num_scf_iterations"]
+    assert [e["it"] for e in iters] == list(
+        range(1, res["num_scf_iterations"] + 1))
+    assert iters[-1]["e_total"] == pytest.approx(
+        res["energy"]["total"], abs=1e-6)
+    # control.trace_capture produced a loadable TensorBoard trace dir
+    trace_files = list((tmp_path / "tracedir").rglob("*.xplane.pb"))
+    assert trace_files, "no xplane.pb under the trace dir"
+    starts = [e for e in evs if e["kind"] == "trace_capture"
+              and e["phase"] == "start"]
+    stops = [e for e in evs if e["kind"] == "trace_capture"
+             and e["phase"] == "stop"]
+    assert len(starts) == 1 and len(stops) == 1
+
+
+def test_recovery_events_appear_exactly_once(tmp_path):
+    from sirius_tpu.utils import faults
+
+    faults.install([("scf.potential", 4, "nan")])
+    try:
+        res = run_tiny_scf(tmp_path, events_path="events.jsonl",
+                           device_scf="off")
+    finally:
+        faults.clear()
+    obs.close_events()
+    assert res["recovery"]["recoveries"] >= 1
+    evs = obs.read_events(str(tmp_path / "events.jsonl"), kind="recovery")
+    assert len(evs) == res["recovery"]["recoveries"]
+    assert evs[0]["sentinel"] == "potential_nonfinite"
+    assert evs[0]["action"] == "flush_history"
+
+
+# ---------------------------------------------------------------------------
+# serve engine: /metrics + /healthz + stats edge cases (satellite 3)
+
+
+def test_percentile_edge_cases():
+    assert _percentile([5.0], 50) == 5.0
+    assert _percentile([5.0], 95) == 5.0
+    assert _percentile([3.0, 3.0, 3.0], 0) == 3.0
+    assert _percentile([3.0, 3.0, 3.0], 99) == 3.0
+    xs = list(range(1, 101))
+    assert _percentile(xs, 50) in (50, 51)  # nearest-rank, 99 gaps
+    assert _percentile(xs, 95) == 95
+    assert _percentile(xs, 100) == 100
+    assert _percentile(list(reversed(xs)), 95) == 95  # sorts internally
+
+
+def test_engine_stats_with_no_jobs():
+    eng = ServeEngine(num_slices=1)
+    s = eng.stats()
+    assert s["num_jobs"] == 0
+    assert s["num_done"] == 0
+    assert s["p50_latency_s"] is None
+    assert s["p95_latency_s"] is None
+    assert s["jobs_per_min"] == 0.0
+    snap = eng.metrics_snapshot()
+    assert snap["queue_depth_high_water"] == 0
+    assert "registry" in snap
+
+
+def test_backend_compiles_total_monotone_across_engine_lifetimes():
+    # the listener registration is process-global: counts must never
+    # reset when an engine is torn down and a new one created
+    observed = [obs.backend_compiles_total()]
+
+    def fresh_compile(seed):
+        # a shape no other test uses, so XLA really compiles
+        x = jnp.ones((3, 5 + seed), dtype=jnp.float64)
+        jax.jit(lambda a: (a * 2.0).sum())(x).block_until_ready()
+
+    eng_a = ServeEngine(num_slices=1)
+    eng_a.start()
+    fresh_compile(101)
+    observed.append(obs.backend_compiles_total())
+    eng_a.shutdown()
+
+    eng_b = ServeEngine(num_slices=1)
+    eng_b.start()
+    fresh_compile(202)
+    observed.append(obs.backend_compiles_total())
+    eng_b.shutdown()
+
+    assert observed == sorted(observed)
+    assert observed[1] > observed[0]
+    assert observed[2] > observed[1]
+    # the serve.cache re-exports alias the same counters
+    from sirius_tpu.serve import cache as cache_mod
+
+    assert cache_mod.backend_compiles_total() == observed[-1]
+
+
+requires_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs >=2 CPU devices for a serve run")
+
+
+@requires_mesh
+def test_serve_metrics_endpoint_and_event_log(tmp_path):
+    events_path = tmp_path / "serve_events.jsonl"
+    eng = ServeEngine(
+        num_slices=2, workdir=str(tmp_path), verbose=False,
+        metrics_port=0, events_path=str(events_path),
+    )
+    eng.start()
+    url = eng.metrics_url
+    assert url is not None
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(f"{url}{path}", timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:  # 4xx/5xx still carry a body
+            return e.code, e.read().decode()
+
+    # healthz while accepting work
+    code, body = get("/healthz")
+    assert code == 200
+    health = json.loads(body)
+    assert health["ok"] is True
+    assert health["num_slices"] == 2
+
+    from tools.loadgen import make_deck
+
+    for i in range(2):
+        eng.submit(make_deck(), job_id=f"obs-{i}")
+    assert eng.wait_all(timeout=600.0)
+
+    code, text = get("/metrics")
+    assert code == 200
+    # acceptance: queue, latency, cache, compile and device-memory series
+    for series in (
+        "serve_queue_depth",
+        "serve_job_latency_seconds",
+        "serve_cache_jobs_total",
+        "jax_backend_compiles_total",
+        "jax_device_memory_bytes",
+        "scf_iterations_total",
+    ):
+        assert series in text, f"missing series {series}"
+
+    # trace endpoint arms a capture (409 on double-arm)
+    code, body = get(f"/debug/trace?steps=1&dir={tmp_path}/trace_ep")
+    assert code == 202 and json.loads(body)["armed"] is True
+    code, body = get(f"/debug/trace?steps=1&dir={tmp_path}/trace_ep2")
+    assert code == 409
+    code, body = get("/debug/trace/status")
+    assert code == 200
+
+    eng.shutdown(wait=True)
+    obs.close_events()
+
+    # every job lifecycle appears exactly once in the JSONL log
+    evs = obs.read_events(str(events_path))
+    for job in eng._submitted:
+        trans = [e for e in evs if e["kind"] == "job_transition"
+                 and e["job_id"] == job.id]
+        assert [e["status"] for e in trans] == [s for _, s, _ in job.events]
+        assert trans[-1]["status"] == "done"
+        # SCF iteration records attribute to the job that ran them
+        scf_evs = [e for e in evs if e["kind"] == "scf_iteration"
+                   and e.get("job_id") == job.id]
+        iters = job.result["num_scf_iterations"]
+        assert len(scf_evs) == iters
+    # endpoint is down after shutdown
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"{url}/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# logging context
+
+
+def test_job_context_rides_into_log_records_and_events(tmp_path):
+    obs.configure_events(str(tmp_path / "ev.jsonl"))
+    with obs.job_context("jid-1", step=7):
+        obs.emit("probe")
+    obs.emit("probe_outside")
+    obs.close_events()
+    evs = obs.read_events(str(tmp_path / "ev.jsonl"))
+    assert evs[0]["job_id"] == "jid-1" and evs[0]["step"] == 7
+    assert "job_id" not in evs[1] and "step" not in evs[1]
+
+    # plain threads do NOT inherit the context (which is why the serve
+    # scheduler sets job_context explicitly inside each worker)
+    seen = {}
+
+    def worker():
+        from sirius_tpu.obs.log import current_job_id
+        seen["job"] = current_job_id()
+
+    with obs.job_context("jid-2"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=10)
+    assert seen["job"] is None
+
+
+def test_events_unconfigured_emit_is_noop(tmp_path):
+    assert not obs.events_configured()
+    obs.emit("nothing_happens", x=1)  # must not raise
+
+    # numpy payloads serialize
+    obs.configure_events(str(tmp_path / "np.jsonl"))
+    obs.emit("np_payload", arr=np.arange(3), scalar=np.float64(2.5))
+    obs.close_events()
+    rec = obs.read_events(str(tmp_path / "np.jsonl"))[0]
+    assert rec["arr"] == [0, 1, 2]
+    assert rec["scalar"] == 2.5

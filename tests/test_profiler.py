@@ -76,3 +76,37 @@ def test_counters_are_thread_local_but_collect_sums():
     assert profiler.counters["shared_key"] == 1
     # ...while collect() sums over every registered thread
     assert profiler.collect()["counters"]["shared_key"] == 6
+
+
+def test_dead_worker_states_are_pruned_but_collect_totals_survive():
+    # regression: the registry used to key states by thread.ident, which
+    # the OS recycles — dead serve workers accumulated forever and a
+    # reused ident could clobber a live thread's state
+    profiler.counters.clear()
+    hold = threading.Event()
+    ready = threading.Barrier(9)
+
+    def work():
+        profiler.counters.clear()
+        profiler.counters["pruned_key"] += 1
+        profiler.add_time("pruned_span", 0.25)
+        ready.wait(timeout=10)
+        hold.wait(timeout=10)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    ready.wait(timeout=10)  # all 8 registered and alive
+    size_alive = profiler.registry_size()
+    assert size_alive >= 8
+    hold.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    assert profiler.prune_dead_threads() <= size_alive - 8
+
+    # the dead workers' numbers still sum into collect() via _retired
+    merged = profiler.collect()
+    assert merged["counters"]["pruned_key"] == 8
+    assert merged["timers"]["pruned_span"]["count"] == 8
+    assert "_retired" in merged["threads"]

@@ -94,11 +94,14 @@ def main(argv=None) -> int:
     from sirius_tpu.serve.engine import ServeEngine
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="sirius_loadgen_")
-    eng = ServeEngine(num_slices=args.slices, workdir=workdir, verbose=True)
+    eng = ServeEngine(num_slices=args.slices, workdir=workdir, verbose=True,
+                      events_path=os.path.join(workdir, "events.jsonl"))
     eng.start()
     for i, deck in enumerate(deck_mix(args.jobs)):
         eng.submit(deck, job_id=f"lg-{i}")
     ok = eng.wait_all(timeout=3600.0)
+    # snapshot BEFORE shutdown so queue/latency gauges reflect the run
+    obs_snap = eng.metrics_snapshot()
     eng.shutdown(wait=True)
 
     stats = eng.stats()
@@ -116,6 +119,17 @@ def main(argv=None) -> int:
         "cache_hit_rate": stats["cache"]["hit_rate"],
         "cache": stats["cache"],
         "retries_total": stats["retries_total"],
+        # final observability snapshot: compile counts, queue high-water,
+        # per-bucket latency histograms — the full registry dump
+        "obs": {
+            "backend_compiles_total": obs_snap["backend_compiles_total"],
+            "queue_depth_high_water": obs_snap["queue_depth_high_water"],
+            "cache_hit_rate": stats["cache"]["hit_rate"],
+            "latency_by_bucket": obs_snap["registry"].get(
+                "serve_job_run_seconds", {}).get("samples", []),
+            "registry": obs_snap["registry"],
+        },
+        "events_log": os.path.join(workdir, "events.jsonl"),
         "per_job": [j.to_dict() for j in eng._submitted],
     }
     with open(args.out, "w") as f:
