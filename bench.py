@@ -43,19 +43,13 @@ LARGE_ANCHOR_S = (
     SI511GE_NODE_S / SI511GE_ASSUMED_ITERS * (54.0 / 512.0) ** 3
 )
 
-# nominal fp32 peak GFLOPS per accelerator class for the MFU figure
-# (override with BENCH_PEAK_GFLOPS when the actual chip is known):
-# TPU v5p-class 229.5e3 (half the 459e3 bf16 MXU peak), P100 9.3e3 (the
-# BASELINE.md anchor GPU), CPU ~76.8/core (24 f32 FLOP/cycle @ 3.2 GHz)
+# accelerator peak table for the MFU figure: the shared one in
+# sirius_tpu/obs/costs.py (override with BENCH_PEAK_GFLOPS or
+# SIRIUS_TPU_PEAK_GFLOPS when the actual chip is unlisted)
 def _peak_gflops(platform: str) -> float:
-    env = os.environ.get("BENCH_PEAK_GFLOPS")
-    if env:
-        return float(env)
-    return {
-        "tpu": 229.5e3,
-        "gpu": 9.3e3,
-        "cuda": 9.3e3,
-    }.get(platform, 76.8 * (os.cpu_count() or 1))
+    from sirius_tpu.obs.costs import peak_gflops
+
+    return peak_gflops(platform)
 
 
 def _probe(platform: str) -> None:
@@ -72,18 +66,12 @@ def _probe(platform: str) -> None:
 
 
 def _hpsi_flops(nb: int, ngk: int, nbeta: int, box) -> float:
-    """Flops of ONE H*psi + S*psi application on [nb, ngk] (the counter the
-    reference self-reports as GFLOPS, wave_functions.hpp:1790-1833):
-    per band two complex FFTs on the coarse box (5 N log2 N each), the
-    pointwise V multiply, the kinetic diagonal, and the beta-projector
-    einsums (project, D/Q apply, expand for both H and S; 8 flops/cmac)."""
-    import math
+    """Flops of ONE H*psi + S*psi application on [nb, ngk] — delegates to
+    the shared analytic cost model (sirius_tpu/obs/costs.py), which keeps
+    the historical formula and is unit-tested against hand counts."""
+    from sirius_tpu.obs.costs import hpsi_flops
 
-    n = box[0] * box[1] * box[2]
-    fft = 2 * 5.0 * n * math.log2(max(n, 2))
-    local = 7.0 * n + 8.0 * ngk
-    nl = 8.0 * (3.0 * nbeta * ngk + 2.0 * nbeta * nbeta)
-    return nb * (fft + local + nl)
+    return hpsi_flops(nb, ngk, nbeta, box)
 
 
 def _workload(tier: str, platform: str) -> None:

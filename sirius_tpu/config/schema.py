@@ -104,6 +104,11 @@ class ControlConfig:
     events_path: str = ""
     trace_capture: str = ""
     trace_capture_steps: int = 5
+    # span_fence: block_until_ready inside device-bound spans so the span
+    # timeline attributes compute to the stage that launched it instead of
+    # the first blocking readback (obs/spans.py). Costs a device sync per
+    # stage — bench_regress turns it on; production leaves it off.
+    span_fence: bool = False
 
 
 @dataclasses.dataclass

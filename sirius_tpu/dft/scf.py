@@ -35,8 +35,10 @@ from sirius_tpu.ops.atomic import atomic_orbitals
 from sirius_tpu.ops.augmentation import d_operator, rho_aug_g
 from sirius_tpu.ops.hamiltonian import apply_h_s, make_hk_params
 from sirius_tpu.solvers.davidson import davidson
+from sirius_tpu.obs import costs as obs_costs
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import spans as obs_spans
 from sirius_tpu.obs.log import get_logger
 from sirius_tpu.obs.trace import CAPTURE as obs_trace
 from sirius_tpu.utils import checksums as _cks
@@ -663,6 +665,31 @@ def run_scf(
     e_prev, converged, rms, scf_correction = None, False, 0.0, 0.0
     num_iter_done = 0
     itsol = cfg.iterative_solver
+    # --- performance-attribution spans (obs/spans.py): per-stage wall
+    # clocks recorded alongside (not replacing) the cumulative profiler
+    # tree, each annotated with the analytic flops/bytes of its stage so
+    # the timeline reports achieved GFLOP/s and roofline headroom ---
+    _span_fence = bool(getattr(cfg.control, "span_fence", False))
+    try:
+        _stage_costs = obs_costs.scf_stage_costs(
+            nk, ns, nb, int(ctx.gkvec.ngk_max),
+            int(ctx.beta.num_beta_total), tuple(ctx.fft_coarse.dims), ng,
+            itsol.num_steps, box_fine=tuple(ctx.gvec.fft.dims),
+            mix_history=int(cfg.mixer.max_history), aug=ctx.aug is not None)
+    except Exception:
+        _stage_costs = {}
+
+    def _stage_record(stage, dur_s, **attrs):
+        c = _stage_costs.get(stage)
+        obs_spans.record(stage, dur_s, flops=c.flops if c else 0.0,
+                         bytes=c.bytes if c else 0.0, **attrs)
+
+    def _fence(tree):
+        # best-effort sync for truthful attribution (span_fence decks only)
+        try:
+            jax.block_until_ready(tree)
+        except Exception:
+            pass
     # adaptive band-solve tolerance, tightened each iteration with the
     # density residual (reference schedule dft_ground_state.cpp:252-259);
     # a static bar leaves a locked-band noise floor in the density that can
@@ -941,6 +968,10 @@ def run_scf(
         it0=it0, num_dft_iter=p.num_dft_iter, resumed=resume is not None,
         xc=list(p.xc_functionals), precision_wf=p.precision_wf,
     )
+    # everything since run_scf entry (context/tables/initial guess/fused
+    # compile trigger) is one externally-timed setup span
+    obs_spans.record("scf.setup", time.time() - t0, t0=t0,
+                     fused=fused is not None)
     _it_t0 = time.time()
     for it in range(it0, p.num_dft_iter):
         obs_trace.tick()
@@ -949,6 +980,7 @@ def run_scf(
         if fused is None or fused_out is None:
             # host D/v0 from the host potential; once the fused step has
             # run, the refreshed D and v0 live on device (fused_out)
+            _dm_t0 = time.perf_counter()
             d_by_spin = []
             for ispn in range(ns):
                 if ctx.aug is not None:
@@ -963,6 +995,9 @@ def run_scf(
                 # the screened D before the band solve
                 d_by_spin = paw_mod.add_dij_to_d(paw, paw_res["dij_atoms"], d_by_spin)
             v0 = float(np.real(pot.veff_g[0]))
+            _stage_record("scf.d_matrix", time.perf_counter() - _dm_t0,
+                          it=it + 1)
+        _bs_t0 = time.perf_counter()
         with profile("scf::band_solve"):
             if gsh is not None:
                 from sirius_tpu.ops.hamiltonian import real_dtype_of
@@ -1315,6 +1350,15 @@ def run_scf(
             counters["num_loc_op_applied"] += nk * ns * num_applies(
                 itsol.num_steps, nb
             )
+        if _span_fence:
+            # the host paths already fenced via np.asarray(ev); only the
+            # device-resident (fused) solve still has compute in flight
+            if fused is not None:
+                _fence((ev_dev, pr, pi))
+            elif pr is not None:
+                _fence((pr, pi))
+        _stage_record("scf.band_solve", time.perf_counter() - _bs_t0,
+                      it=it + 1, num_steps=itsol.num_steps)
         # --- band-solve supervision (dft/recovery.py): a stagnated or
         # blown-up solve is retried with a deeper subspace; the serial
         # debug path additionally falls back to dense diagonalization for
@@ -1411,11 +1455,22 @@ def run_scf(
             # search, density, mixing, potential and the D/h_diag refresh
             # all run on device; ONE scalar vector comes back ---
             with profile("scf::fused_step"):
+                # sub-stage clocks: honest per-stage splits need span_fence
+                # (each _fence is a sync, not a transfer — the transfer
+                # guard of test_fused_no_host_transfers stays satisfied);
+                # unfenced, dispatch latency is recorded per stage and the
+                # queued compute lands in scf.readback below
+                _fu_t = time.perf_counter()
                 mu, occ, entropy_sum = find_fermi(
                     ev_dev, fused.kweights_dev, fused_nel, fused_width,
                     kind=p.smearing, max_occupancy=fused_occmax,
                 )
                 occ_w = occ * fused.kweights_dev[:, None, None]
+                if _span_fence:
+                    _fence(occ_w)
+                _stage_record("scf.occupations",
+                              time.perf_counter() - _fu_t, it=it + 1)
+                _fu_t = time.perf_counter()
                 from sirius_tpu.parallel.batched import (
                     density_kset,
                     density_matrix_kset,
@@ -1432,12 +1487,24 @@ def run_scf(
                     )
                 else:
                     dm_re, dm_im = fused_dm0
+                if _span_fence:
+                    _fence((acc, dm_re, dm_im))
+                _stage_record("scf.density",
+                              time.perf_counter() - _fu_t, it=it + 1)
+                _fu_t = time.perf_counter()
                 fused_carry, fused_out = fused.step(
                     fused_carry, acc, dm_re, dm_im, ev_dev, occ_w,
                     entropy_sum,
                 )
+                if _span_fence:
+                    _fence(fused_out)
+                _stage_record("scf.fused_step",
+                              time.perf_counter() - _fu_t, it=it + 1)
             # the ONLY per-iteration device->host fetch
+            _rb_t0 = time.perf_counter()
             fused_np = np.asarray(fused_out["scalars"])
+            _stage_record("scf.readback", time.perf_counter() - _rb_t0,
+                          it=it + 1)
             if (not np.all(np.isfinite(fused_np))
                     or fused_np[S_FINITE] != 1.0):
                 # non-finite fields on device: roll back and escalate
@@ -1477,12 +1544,15 @@ def run_scf(
                 mag_history.append(float(fused_np[S_MAG]))
             num_iter_done = it + 1
             _ITERATIONS.inc(path="fused")
-            _ITER_SECONDS.observe(time.time() - _it_t0)
+            _it_dt = time.time() - _it_t0
+            _ITER_SECONDS.observe(_it_dt)
             _RMS.set(rms)
             _ETOT.set(e_total)
+            _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
+                          path="fused")
             obs_events.emit(
                 "scf_iteration", it=it + 1, path="fused", rms=rms,
-                e_total=e_total, dt=time.time() - _it_t0,
+                e_total=e_total, dt=_it_dt,
                 scalars=[float(v) for v in fused_np],
             )
             if cfg.control.verbosity >= 2:
@@ -1526,6 +1596,7 @@ def run_scf(
         # fault site: NaN into the band energies (detected with the other
         # non-finite fields after the density assembly below)
         evals = faults.corrupt("scf.evals", it, evals)
+        _oc_t0 = time.perf_counter()
         mu, occ, entropy_sum = find_fermi(
             jnp.asarray(evals),
             jnp.asarray(ctx.kweights),
@@ -1534,7 +1605,9 @@ def run_scf(
             kind=p.smearing,
             max_occupancy=ctx.max_occupancy,
         )
-        occ_np = np.asarray(occ)
+        occ_np = np.asarray(occ)  # self-fencing host fetch
+        _stage_record("scf.occupations", time.perf_counter() - _oc_t0,
+                      it=it + 1)
 
         # --- Hubbard occupation matrix (mixed jointly with the density) ---
         om_new = None
@@ -1577,6 +1650,7 @@ def run_scf(
             )
 
         # --- density (per spin, then charge/magnetization assembly) ---
+        _de_t0 = time.perf_counter()
         occ_w = jnp.asarray(occ_np * ctx.kweights[:, None, None])
         with profile("scf::density"):
             if (serial_bands or gamma_bands or gsh is not None
@@ -1658,6 +1732,9 @@ def run_scf(
         rho_new = faults.corrupt("scf.density", it, rho_new)
         x_new = pack(rho_new, mag_new, om_new, om_nl_new, paw_dm_new,
                      hub_lagrange)
+        # the span extends past profile("scf::density") through augmentation,
+        # symmetrization and packing — the full "new density" stage
+        _stage_record("scf.density", time.perf_counter() - _de_t0, it=it + 1)
         rho_resid_g = rho_new - rho_g  # output - input density (scf-corr force)
         if not np.all(np.isfinite(evals)) or not np.isfinite(
             np.sum(np.abs(x_new))
@@ -1680,6 +1757,7 @@ def run_scf(
             ]
             _recover("nonfinite_fields", detail=f"non-finite {bad}")
             continue
+        _mx_t0 = time.perf_counter()
         rms = mixer.rms(x_mix, x_new)
         x_mix = mixer.mix(x_mix, x_new)
         # density criterion in the reference's metric: with use_hartree the
@@ -1694,6 +1772,7 @@ def run_scf(
         res_tol = schedule_res_tol(itsol, res_tol, dens_metric, nel,
                                    mixer.use_hartree and eha_res is not None)
         rho_g, mag_g, om_mixed, om_nl_mixed, paw_dm, lam_mixed = unpack(x_mix)
+        _stage_record("scf.mixing", time.perf_counter() - _mx_t0, it=it + 1)
         if lam_mixed is not None:
             hub_lagrange = lam_mixed  # quasi-Newton-mixed multipliers
         if hub is not None:
@@ -1726,8 +1805,11 @@ def run_scf(
         e1 = _epot(rho_new, mag_new, pot)
 
         # --- potential + energies ---
+        _pt_t0 = time.perf_counter()
         with profile("scf::potential"):
             pot = generate_potential(ctx, rho_g, xc, mag_g, tau_g=tau_g)
+        _stage_record("scf.potential", time.perf_counter() - _pt_t0,
+                      it=it + 1)
         # fault site: NaN into the generated effective potential
         pot.veff_r_coarse = faults.corrupt(
             "scf.potential", it, pot.veff_r_coarse)
@@ -1762,12 +1844,15 @@ def run_scf(
             mag_history.append(float(np.real(mag_new[0]) * ctx.unit_cell.omega))
         num_iter_done = it + 1
         _ITERATIONS.inc(path="host")
-        _ITER_SECONDS.observe(time.time() - _it_t0)
+        _it_dt = time.time() - _it_t0
+        _ITER_SECONDS.observe(_it_dt)
         _RMS.set(rms)
         _ETOT.set(e_total)
+        _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
+                      path="host")
         obs_events.emit(
             "scf_iteration", it=it + 1, path="host", rms=rms,
-            e_total=e_total, dt=time.time() - _it_t0,
+            e_total=e_total, dt=_it_dt,
             # host-path equivalent of the fused [16] scalar record
             scalars={"eval_sum": eval_sum, "vha": e["vha"], "vxc": e["vxc"],
                      "exc": e["exc"], "bxc": e["bxc"],

@@ -37,6 +37,7 @@ import numpy as np
 from sirius_tpu.md.extrapolate import AspcExtrapolator, SubspaceExtrapolator
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import spans as obs_spans
 from sirius_tpu.obs.log import get_logger, job_context
 
 logger = get_logger("md")
@@ -207,17 +208,19 @@ def run_md(
             # extrapolation payoff against exactly this)
             init = None
         else:
-            rho_pred = rho_x.predict()
-            psi_pred = psi_x.predict()
-            if psi_pred is not None:
-                psi_pred = _orthonormalize(psi_pred)
-            init = warm_start_state(
-                carry["state"], rho_g=rho_pred, psi=psi_pred
+            with obs_spans.span("md.extrapolate", step=step_index):
+                rho_pred = rho_x.predict()
+                psi_pred = psi_x.predict()
+                if psi_pred is not None:
+                    psi_pred = _orthonormalize(psi_pred)
+                init = warm_start_state(
+                    carry["state"], rho_g=rho_pred, psi=psi_pred
+                )
+        with obs_spans.span("md.scf", step=step_index, warm=init is not None):
+            res = run_scf(
+                cfg, base_dir, ctx=ctx_step, initial_state=init,
+                keep_state=True, exec_cache=exec_cache,
             )
-        res = run_scf(
-            cfg, base_dir, ctx=ctx_step, initial_state=init,
-            keep_state=True, exec_cache=exec_cache,
-        )
         if not res.get("converged", False) and init is not None:
             # MD-level recovery ladder rung: the extrapolated guess can be
             # poisoned after an SCF-level recovery event; one cold retry
@@ -225,10 +228,11 @@ def run_md(
                 f"MD step {step_index}: warm-started SCF did not converge; "
                 "retrying from the atomic superposition"
             )
-            res = run_scf(
-                cfg, base_dir, ctx=ctx_step, keep_state=True,
-                exec_cache=exec_cache,
-            )
+            with obs_spans.span("md.scf", step=step_index, warm=False):
+                res = run_scf(
+                    cfg, base_dir, ctx=ctx_step, keep_state=True,
+                    exec_cache=exec_cache,
+                )
         if not res.get("converged", False):
             warnings.warn(
                 f"MD step {step_index}: SCF unconverged after cold retry; "
@@ -347,12 +351,15 @@ def run_md(
             n0 = backend_compiles_total()
             t_step0 = time.time()
             with job_context(step=step + 1):
-                r_cart, velocities, f_cur, e_pot, extra = (
-                    velocity_verlet_step(
-                        r_cart, velocities, f_cur, masses, dt, thermostat,
-                        step, lambda r: evaluate(r, step_index=step + 1),
-                        tracker,
-                    ))
+                # md.integrate parents the md.extrapolate / md.scf spans
+                # fired from the evaluate() force callback
+                with obs_spans.span("md.integrate", step=step + 1):
+                    r_cart, velocities, f_cur, e_pot, extra = (
+                        velocity_verlet_step(
+                            r_cart, velocities, f_cur, masses, dt, thermostat,
+                            step, lambda r: evaluate(r, step_index=step + 1),
+                            tracker,
+                        ))
             e_kin = kinetic_energy(velocities, masses)
             e_cons = tracker.record(e_kin, e_pot)
             rec = {

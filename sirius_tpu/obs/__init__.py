@@ -35,9 +35,36 @@ from sirius_tpu.obs.metrics import (
 )
 from sirius_tpu.obs.trace import CAPTURE
 
+# spans/costs AFTER events/metrics: spans.py imports those submodules, so
+# it must come once their attributes exist on the partial package
+from sirius_tpu.obs.costs import (
+    StageCost,
+    annotate_span,
+    peak_gbps,
+    peak_gflops,
+    xla_cost_analysis,
+)
+from sirius_tpu.obs.spans import (
+    capture as capture_spans,
+    current as current_span,
+    record as record_span,
+    span,
+    spanned,
+)
+
 __all__ = [
     "REGISTRY",
     "CAPTURE",
+    "span",
+    "spanned",
+    "capture_spans",
+    "record_span",
+    "current_span",
+    "StageCost",
+    "annotate_span",
+    "peak_gflops",
+    "peak_gbps",
+    "xla_cost_analysis",
     "emit",
     "configure_events",
     "events_configured",

@@ -1,0 +1,311 @@
+"""Analytic cost model: FLOPs / bytes-moved per SCF stage from deck
+shapes, the shared accelerator peak table, and roofline annotations.
+
+This is the single source of truth for "how much work is that stage":
+
+- `peak_gflops()` / `peak_gbps()`: the accelerator peak table (moved
+  here from bench.py's private copy) with env overrides
+  (``BENCH_PEAK_GFLOPS`` kept for compatibility, plus
+  ``SIRIUS_TPU_PEAK_GFLOPS`` / ``SIRIUS_TPU_PEAK_GBPS``) for unlisted
+  hardware;
+- per-kernel FLOP formulas (`fft_flops`, `hpsi_flops`,
+  `beta_gemm_flops`, ...) — the self-reported work counters of the
+  reference (wave_functions.hpp:1790-1833) generalized to every hot
+  stage; complex MACs count 8 flops, complex FFTs 5 N log2 N;
+- `scf_stage_costs()`: one `StageCost` (flops + bytes) per span name of
+  an SCF iteration, which bench_regress and the span layer use to
+  annotate measured durations with achieved GFLOP/s, the roofline
+  ceiling min(peak, intensity * bandwidth), and MFU;
+- `xla_cost_analysis()`: the cross-check against what XLA itself counts
+  via ``jitted.lower(...).compile().cost_analysis()`` — returns None
+  (degrade, never raise) on backends that provide nothing.
+
+The byte counts are a minimal-traffic model (each operand read once,
+each result written once, complex128 = 16 B) — good enough to place a
+stage on the roofline, not a cache simulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+# nominal fp32 peak GFLOPS per accelerator class (BASELINE.md anchors):
+# TPU v5p-class 229.5e3 (half the 459e3 bf16 MXU peak), P100 9.3e3, CPU
+# ~76.8/core (24 f32 FLOP/cycle @ 3.2 GHz)
+PEAK_GFLOPS = {
+    "tpu": 229.5e3,
+    "gpu": 9.3e3,
+    "cuda": 9.3e3,
+}
+CPU_CORE_GFLOPS = 76.8
+
+# nominal memory bandwidth GB/s per class: TPU v5p HBM 2765, P100 HBM
+# 732, CPU ~6.4/core (shared DDR; deliberately coarse)
+PEAK_GBPS = {
+    "tpu": 2765.0,
+    "gpu": 732.0,
+    "cuda": 732.0,
+}
+CPU_CORE_GBPS = 6.4
+
+
+def detect_platform() -> str:
+    """Backend platform string without forcing a jax init ("cpu" when
+    jax is unavailable or uninitialized-and-unneeded)."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def peak_gflops(platform: str | None = None,
+                override: float | None = None) -> float:
+    """Shared accelerator peak table (fp32 GFLOPS). Resolution order:
+    explicit ``override`` (config) > ``BENCH_PEAK_GFLOPS`` /
+    ``SIRIUS_TPU_PEAK_GFLOPS`` env > class table > per-core CPU model."""
+    if override:
+        return float(override)
+    env = (os.environ.get("BENCH_PEAK_GFLOPS")
+           or os.environ.get("SIRIUS_TPU_PEAK_GFLOPS"))
+    if env:
+        return float(env)
+    if platform is None:
+        platform = detect_platform()
+    return PEAK_GFLOPS.get(platform, CPU_CORE_GFLOPS * (os.cpu_count() or 1))
+
+
+def peak_gbps(platform: str | None = None,
+              override: float | None = None) -> float:
+    """Nominal memory bandwidth (GB/s) for the roofline ceiling."""
+    if override:
+        return float(override)
+    env = os.environ.get("SIRIUS_TPU_PEAK_GBPS")
+    if env:
+        return float(env)
+    if platform is None:
+        platform = detect_platform()
+    return PEAK_GBPS.get(platform, CPU_CORE_GBPS * (os.cpu_count() or 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class StageCost:
+    """Analytic work of one stage: flops + bytes moved."""
+
+    flops: float
+    bytes: float = 0.0
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity flops/byte (inf for byte-free models)."""
+        return self.flops / self.bytes if self.bytes > 0 else float("inf")
+
+    def gflops(self, dur_s: float) -> float:
+        return self.flops / dur_s / 1e9 if dur_s > 0 else 0.0
+
+    def roofline_gflops(self, platform: str | None = None,
+                        peak: float | None = None,
+                        bw_gbps: float | None = None) -> float:
+        """min(compute peak, intensity * bandwidth) — the ceiling this
+        stage could reach on the given hardware."""
+        pk = peak if peak is not None else peak_gflops(platform)
+        bw = bw_gbps if bw_gbps is not None else peak_gbps(platform)
+        if self.bytes <= 0:
+            return pk
+        return min(pk, self.intensity * bw)
+
+    def mfu(self, dur_s: float, platform: str | None = None,
+            peak: float | None = None) -> float:
+        pk = peak if peak is not None else peak_gflops(platform)
+        return self.gflops(dur_s) / pk if pk > 0 else 0.0
+
+
+def annotate_span(dur_s: float, flops: float, bytes: float = 0.0,
+                  platform: str | None = None,
+                  peak: float | None = None) -> dict:
+    """Roofline annotation fields for a measured span duration."""
+    c = StageCost(flops=float(flops), bytes=float(bytes))
+    roof = c.roofline_gflops(platform=platform, peak=peak)
+    return {
+        "flops": c.flops,
+        "bytes": c.bytes,
+        "gflops": c.gflops(dur_s),
+        "roofline_gflops": roof,
+        "mfu": c.mfu(dur_s, platform=platform, peak=peak),
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-kernel FLOP formulas (exact closed forms — tests hand-count these)
+
+
+def _nbox(box) -> int:
+    return int(box[0]) * int(box[1]) * int(box[2])
+
+
+def fft_flops(box, batch: int = 1) -> float:
+    """One complex FFT on `box` costs 5 N log2 N real flops (the
+    standard split-radix count the reference also reports)."""
+    n = _nbox(box)
+    return float(batch) * 5.0 * n * math.log2(max(n, 2))
+
+
+def fft_bytes(box, batch: int = 1, itemsize: int = 16) -> float:
+    """Minimal traffic of one complex FFT: read + write the box once."""
+    return float(batch) * 2.0 * itemsize * _nbox(box)
+
+
+def beta_gemm_flops(nb: int, nbeta: int, ngk: int) -> float:
+    """One beta-projection GEMM <beta|psi>: [nb, ngk] x [ngk, nbeta]
+    complex, 8 flops per complex MAC."""
+    return 8.0 * nb * nbeta * ngk
+
+
+def beta_gemm_bytes(nb: int, nbeta: int, ngk: int,
+                    itemsize: int = 16) -> float:
+    return float(itemsize) * (nb * ngk + nbeta * ngk + nb * nbeta)
+
+
+def hpsi_flops(nb: int, ngk: int, nbeta: int, box) -> float:
+    """Flops of ONE H*psi + S*psi application on [nb, ngk] (the counter
+    the reference self-reports as GFLOPS): per band two complex FFTs on
+    the coarse box, the pointwise V multiply, the kinetic diagonal, and
+    the beta-projector einsums (project, D/Q apply, expand for both H
+    and S; 8 flops/cmac). Identical to the historical bench.py model."""
+    n = _nbox(box)
+    fft = 2 * 5.0 * n * math.log2(max(n, 2))
+    local = 7.0 * n + 8.0 * ngk
+    nl = 8.0 * (3.0 * nbeta * ngk + 2.0 * nbeta * nbeta)
+    return nb * (fft + local + nl)
+
+
+def hpsi_bytes(nb: int, ngk: int, nbeta: int, box,
+               itemsize: int = 16) -> float:
+    """Minimal traffic of one H*psi + S*psi: per band two FFT round
+    trips + veff read + psi read/write, plus one read of the projector
+    table and the projection coefficients."""
+    n = _nbox(box)
+    per_band = 2 * 2.0 * itemsize * n + 8.0 * n + 2.0 * itemsize * ngk
+    return nb * per_band + itemsize * (nbeta * ngk + 2.0 * nb * nbeta)
+
+
+def davidson_applies(num_steps: int, nb: int,
+                     refresh_every: int | None = None) -> int:
+    """H-applications in band rows of one davidson() call (delegates to
+    solvers/davidson.num_applies so the counts can never drift)."""
+    from sirius_tpu.solvers.davidson import REFRESH_EVERY, num_applies
+
+    return num_applies(num_steps, nb,
+                       refresh_every=refresh_every or REFRESH_EVERY)
+
+
+def davidson_cost(nb: int, ngk: int, nbeta: int, box,
+                  num_steps: int) -> StageCost:
+    """One davidson() solve: the H/S applications plus the per-step
+    dense subspace algebra (3nb x 3nb Gram products, the Rayleigh-Ritz
+    eigensolve, and the rotation GEMMs back to the band block)."""
+    rows = davidson_applies(num_steps, nb)
+    apply_f = hpsi_flops(1, ngk, nbeta, box) * rows
+    apply_b = hpsi_bytes(1, ngk, nbeta, box) * rows
+    m = 3 * nb  # [X, K R, P] subspace
+    gram = 2.0 * 8.0 * m * m * ngk  # hsub + ssub
+    eig = 30.0 * m ** 3  # eigh(3nb) + the basis transforms around it
+    rot = 6.0 * 8.0 * nb * m * ngk  # xn/hxn/sxn + pn/hpn/spn
+    sub_f = num_steps * (gram + eig + rot)
+    sub_b = num_steps * 16.0 * (3.0 * m * ngk + 2.0 * m * m)
+    return StageCost(flops=apply_f + sub_f, bytes=apply_b + sub_b)
+
+
+def scf_stage_costs(nk: int, ns: int, nb: int, ngk: int, nbeta: int,
+                    box, ng: int, num_steps: int,
+                    box_fine=None, mix_history: int = 8,
+                    aug: bool = True) -> dict[str, StageCost]:
+    """Per-iteration StageCost keyed by the span names run_scf emits.
+
+    Shapes come straight from the SimulationContext: `box` is the coarse
+    FFT grid (wave functions), `box_fine` the fine grid (density and
+    potential; defaults to the coarse box when not given), `ng` the fine
+    G set, `ngk` the padded |G+k| sphere."""
+    bf = box_fine if box_fine is not None else box
+    nf = _nbox(bf)
+    c: dict[str, StageCost] = {}
+    dav = davidson_cost(nb, ngk, nbeta, box, num_steps)
+    c["scf.band_solve"] = StageCost(flops=nk * ns * dav.flops,
+                                    bytes=nk * ns * dav.bytes)
+    # screened D: augmentation Q * veff integrals on the fine G set
+    dmat = (8.0 * ns * nbeta * nbeta * ng) if aug and nbeta else 2.0 * ng
+    c["scf.d_matrix"] = StageCost(flops=dmat, bytes=16.0 * ns * ng)
+    # fermi search: ~60 bisection sweeps over every band energy
+    c["scf.occupations"] = StageCost(flops=60.0 * 4.0 * nk * ns * nb,
+                                     bytes=8.0 * nk * ns * nb)
+    # density: one inverse FFT + |psi|^2 accumulate per occupied band,
+    # the coarse->fine map, plus the augmentation density matrix GEMM
+    dens = nk * ns * nb * (fft_flops(box) + 2.0 * _nbox(box))
+    dens_b = nk * ns * nb * fft_bytes(box)
+    if aug and nbeta:
+        dens += nk * ns * beta_gemm_flops(nb, nbeta, ngk) + \
+            8.0 * ns * nbeta * nbeta * ng
+        dens_b += 16.0 * (nbeta * ngk + ns * nbeta * nbeta)
+    c["scf.density"] = StageCost(flops=dens, bytes=dens_b)
+    # quasi-Newton mixing: history GEMMs over the packed vector
+    nx = ng * (2 if ns == 2 else 1)
+    c["scf.mixing"] = StageCost(flops=8.0 * nx * (2.0 * mix_history + 4.0),
+                                bytes=16.0 * nx * (mix_history + 2.0))
+    # potential: Hartree (pointwise on G), XC on the fine real grid
+    # (~2 FFT round trips + the functional evaluation)
+    potf = 10.0 * ng + 4.0 * fft_flops(bf) + 80.0 * ns * nf
+    c["scf.potential"] = StageCost(flops=potf,
+                                   bytes=4.0 * fft_bytes(bf) + 16.0 * ng)
+    # fused device step = density assembly + mix + potential + D refresh
+    c["scf.fused_step"] = StageCost(
+        flops=c["scf.mixing"].flops + c["scf.potential"].flops
+        + c["scf.d_matrix"].flops,
+        bytes=c["scf.mixing"].bytes + c["scf.potential"].bytes
+        + c["scf.d_matrix"].bytes,
+    )
+    c["scf.readback"] = StageCost(flops=0.0, bytes=16.0 * 16)
+    c["scf.iteration"] = StageCost(
+        flops=sum(v.flops for k, v in c.items()
+                  if k not in ("scf.fused_step", "scf.readback")),
+        bytes=sum(v.bytes for k, v in c.items()
+                  if k not in ("scf.fused_step", "scf.readback")),
+    )
+    return c
+
+
+# ---------------------------------------------------------------------------
+# XLA cross-check
+
+
+def xla_cost_analysis(jitted, *args, **kwargs) -> dict | None:
+    """FLOP/byte counts from XLA's own cost model for a jitted callable
+    at the given example arguments, or None when the backend provides
+    nothing (older jax, some plugin backends) — callers must treat None
+    as "skip the cross-check", never as a failure."""
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    # historical jax versions returned [dict] per device program
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    return dict(ca)
+
+
+def xla_flops(jitted, *args, **kwargs) -> float | None:
+    """Just the flop count of the cross-check, or None when absent."""
+    ca = xla_cost_analysis(jitted, *args, **kwargs)
+    if ca is None:
+        return None
+    v = ca.get("flops")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None

@@ -298,6 +298,7 @@ def _on_duration_event(event: str, *args, **kwargs) -> None:
         with _compile_lock:
             _compiles_total += 1
         _compiles_tls.count = getattr(_compiles_tls, "count", 0) + 1
+        _compiles_tls.seconds = getattr(_compiles_tls, "seconds", 0.0) + dt
         REGISTRY.counter(
             "jax_backend_compiles_total",
             "XLA backend compilations").inc()
@@ -337,6 +338,12 @@ def backend_compiles_total() -> int:
 
 def backend_compiles_this_thread() -> int:
     return getattr(_compiles_tls, "count", 0)
+
+
+def backend_compile_seconds_this_thread() -> float:
+    """Cumulative XLA backend-compile seconds on the calling thread —
+    deltas across a run give its serve.compile span (scheduler.py)."""
+    return getattr(_compiles_tls, "seconds", 0.0)
 
 
 def update_device_memory_gauges() -> None:

@@ -1,0 +1,225 @@
+"""Nestable wall-clock span timeline — the performance-attribution layer.
+
+`utils/profiler.py` keeps the reference-style cumulative timer tree
+(timers.json report); this module is the *event* view of the same
+instants: every span is one record with identity (span_id), lineage
+(parent_id via a contextvar, so nesting survives generators and
+callbacks), monotonic start/duration, and optional analytic cost
+annotations (GFLOP/s, roofline ceiling, MFU from obs/costs.py when the
+producer attaches a flops/bytes estimate).
+
+Three consumers, all fed on span close:
+
+- the JSONL event sink (obs/events.py): one ``kind="span"`` record per
+  completed span, carrying job_id/step from the logging context;
+- the metrics registry: a ``perf_span_seconds`` histogram labelled by
+  span name (the Prometheus-side view of the timeline);
+- in-process `capture()` collectors: tools/bench_regress.py runs an SCF
+  under `with capture() as cap:` and reads per-stage durations straight
+  from `cap` without parsing the event log.
+
+Device-bound spans and fencing: XLA dispatch is asynchronous, so a bare
+host timer around `davidson_kset(...)` measures dispatch, not compute —
+the wall time lands in whichever span first blocks (usually the scalar
+readback). Durations still *sum* to the true wall time, but per-stage
+attribution is skewed. Passing ``fence=`` (a jax pytree, or assigning
+``sp.fence = out`` inside the block) makes ``__exit__`` call
+``jax.block_until_ready`` on it first, charging the compute to the span
+that launched it. run_scf wires this behind ``control.span_fence``
+(default off: production never pays the sync; bench_regress turns it on
+for truthful attribution).
+
+When telemetry is disabled (``control.telemetry = false`` ->
+obs.metrics.set_enabled(False)) every span is a no-op: ``__enter__``
+returns after one flag test — no contextvar writes, no clock reads, no
+records anywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import itertools
+import threading
+import time
+
+from sirius_tpu.obs import events as _events
+from sirius_tpu.obs import metrics as _metrics
+
+# the innermost live span of this logical context (contextvar, not a
+# thread-local stack: lineage must survive contextvars-aware frameworks
+# and stays isolated per serve worker thread)
+_parent: contextvars.ContextVar = contextvars.ContextVar(
+    "sirius_tpu_span_parent", default=None)
+_next_id = itertools.count(1)
+
+_collectors_lock = threading.Lock()
+_collectors: list["SpanCapture"] = []
+
+
+class SpanCapture:
+    """In-process sink of finished span records (plain dicts)."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, rec: dict) -> None:
+        with self._lock:
+            self.records.append(rec)
+
+    def by_name(self, name: str) -> list[dict]:
+        with self._lock:
+            return [r for r in self.records if r["name"] == name]
+
+    def durations(self, name: str) -> list[float]:
+        return [r["dur_s"] for r in self.by_name(name)]
+
+    def names(self) -> set[str]:
+        with self._lock:
+            return {r["name"] for r in self.records}
+
+
+@contextlib.contextmanager
+def capture():
+    """Collect every span finished anywhere in the process while the
+    context is open (process-global, like the event sink — the producers
+    span serve worker threads)."""
+    cap = SpanCapture()
+    with _collectors_lock:
+        _collectors.append(cap)
+    try:
+        yield cap
+    finally:
+        with _collectors_lock:
+            _collectors.remove(cap)
+
+
+def _finish(rec: dict) -> None:
+    _metrics.REGISTRY.histogram(
+        "perf_span_seconds", "span-timeline durations by span name").observe(
+            rec["dur_s"], span=rec["name"])
+    _events.emit("span", **rec)
+    with _collectors_lock:
+        caps = list(_collectors)
+    for cap in caps:
+        cap.add(rec)
+
+
+class span:
+    """Context manager: ``with span("scf.density", flops=f) as sp: ...``
+
+    ``fence``: jax pytree (or callable returning one) blocked on before
+    the clock stops; assignable inside the block (``sp.fence = out``).
+    ``flops``/``bytes``: analytic cost estimate for this span's work —
+    when given, the record is annotated with achieved GFLOP/s, the
+    roofline ceiling, and MFU against the shared peak table
+    (obs/costs.py). Extra keyword arguments become record fields.
+    """
+
+    __slots__ = ("name", "attrs", "fence", "flops", "bytes", "span_id",
+                 "parent_id", "depth", "dur_s", "_t0", "_t0_wall",
+                 "_token")
+
+    def __init__(self, name: str, fence=None, flops: float = 0.0,
+                 bytes: float = 0.0, **attrs):
+        self.name = name
+        self.fence = fence
+        self.flops = flops
+        self.bytes = bytes
+        self.attrs = attrs
+        self.dur_s = None
+
+    def __enter__(self):
+        if not _metrics.enabled():
+            return self
+        parent = _parent.get()
+        self.span_id = next(_next_id)
+        self.parent_id = parent.span_id if parent is not None else None
+        self.depth = (parent.depth + 1) if parent is not None else 0
+        self._token = _parent.set(self)
+        self._t0_wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not hasattr(self, "_token"):
+            return False  # telemetry was off at __enter__: stay a no-op
+        if self.fence is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(
+                    self.fence() if callable(self.fence) else self.fence)
+            except Exception:
+                pass  # fencing is best-effort observability, never fatal
+        self.dur_s = time.perf_counter() - self._t0
+        _parent.reset(self._token)
+        del self._token
+        rec = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "depth": self.depth,
+            "t0": self._t0_wall,
+            "dur_s": self.dur_s,
+        }
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec.update(self.attrs)
+        if self.flops:
+            from sirius_tpu.obs import costs as _costs
+
+            rec.update(_costs.annotate_span(self.dur_s, self.flops,
+                                            self.bytes))
+        _finish(rec)
+        return False
+
+
+def record(name: str, dur_s: float, t0: float | None = None,
+           flops: float = 0.0, bytes: float = 0.0, **attrs) -> None:
+    """Record an externally-timed span (e.g. serve queue wait measured as
+    a timestamp delta, or a setup phase bracketed by plain perf_counter
+    reads). Lineage comes from the current contextvar like a live span."""
+    if not _metrics.enabled():
+        return
+    parent = _parent.get()
+    rec = {
+        "name": name,
+        "span_id": next(_next_id),
+        "parent_id": parent.span_id if parent is not None else None,
+        "depth": (parent.depth + 1) if parent is not None else 0,
+        "t0": float(t0) if t0 is not None else time.time() - float(dur_s),
+        "dur_s": float(dur_s),
+    }
+    if attrs:
+        rec.update(attrs)
+    if flops:
+        from sirius_tpu.obs import costs as _costs
+
+        rec.update(_costs.annotate_span(float(dur_s), flops, bytes))
+    _finish(rec)
+
+
+def spanned(name: str | None = None, **span_kw):
+    """Decorator form: ``@spanned("md.extrapolate")`` (defaults to the
+    function's qualified name)."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(label, **span_kw):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def current() -> "span | None":
+    """The innermost live span of this context (None at top level)."""
+    return _parent.get()

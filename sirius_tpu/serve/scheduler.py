@@ -28,6 +28,7 @@ import threading
 import numpy as np
 
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import spans as obs_spans
 from sirius_tpu.obs.log import get_logger, job_context
 from sirius_tpu.serve import cache as cache_mod
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
@@ -185,18 +186,35 @@ class SliceScheduler:
             )
             if job.started_at is None:
                 job.started_at = job.events[-1][0]
+            if job.submitted_at is not None:
+                # externally-timed span: submit -> this worker popping it
+                obs_spans.record(
+                    "serve.queue_wait",
+                    max(0.0, _time.time() - job.submitted_at),
+                    t0=job.submitted_at, slice=slice_idx,
+                    bucket="warm" if warm else "cold")
             compiles0 = cache_mod.backend_compiles_this_thread()
+            csec0 = obs_metrics.backend_compile_seconds_this_thread()
             t_run0 = _time.time()
-            with jax.default_device(devs[0]):
-                result = run_scf(
-                    cfg, base_dir=job.base_dir, ctx=ctx,
-                    exec_cache=self.cache, devices=devs,
-                    resume=job.resume_path,
-                )
+            with obs_spans.span("serve.run", slice=slice_idx,
+                                bucket="warm" if warm else "cold"):
+                with jax.default_device(devs[0]):
+                    result = run_scf(
+                        cfg, base_dir=job.base_dir, ctx=ctx,
+                        exec_cache=self.cache, devices=devs,
+                        resume=job.resume_path,
+                    )
             _RUN_SECONDS.observe(_time.time() - t_run0,
                                  bucket="warm" if warm else "cold",
                                  slice=slice_idx)
             compiled = cache_mod.backend_compiles_this_thread() - compiles0
+            # compile time attributed via the jax.monitoring listener's
+            # per-thread accumulator: run_scf happened on THIS thread, so
+            # the delta is exactly this job's XLA backend-compile seconds
+            csec = obs_metrics.backend_compile_seconds_this_thread() - csec0
+            if compiled or csec:
+                obs_spans.record("serve.compile", csec, slice=slice_idx,
+                                 compiled_executables=compiled)
             counters["serve.backend_compiles"] += compiled
             result["serve"] = {
                 "job_id": job.id,

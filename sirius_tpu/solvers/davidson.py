@@ -151,25 +151,35 @@ def davidson(
         # convergence near the solution
         w = w - (w @ x.conj().T) @ x
         w = w / jnp.maximum(jnp.linalg.norm(w, axis=1, keepdims=True), 1e-30)
-        # the ONLY H/S application of the step: the new block
-        hw, sw = apply_h_s(w)
+        # the ONLY H/S application of the step: the new block.  The
+        # named_scope blocks tag the emitted HLO so trace capture
+        # (obs/trace.py) and XLA profiles attribute time to the same four
+        # stage names obs/costs.py models — host spans cannot cut inside
+        # this jit.
+        with jax.named_scope("davidson_hpsi"):
+            hw, sw = apply_h_s(w)
         v = jnp.concatenate([x, w, p], axis=0)  # (3nb, ng)
         hv = jnp.concatenate([hx, hw, hp], axis=0)
         sv = jnp.concatenate([sx, sw, sp], axis=0)
-        hsub = v.conj() @ hv.T
-        ssub = v.conj() @ sv.T
-        hsub = 0.5 * (hsub + hsub.conj().T)
-        ssub = 0.5 * (ssub + ssub.conj().T)
-        e, c = _rayleigh_ritz(hsub, ssub, nb)
-        # X' = V C and the carried H X' = (H V) C, S X' = (S V) C exactly
-        xn = (c.T @ v) * mask
-        hxn = (c.T @ hv) * mask
-        sxn = (c.T @ sv) * mask
-        # new search direction: the non-X part of the update (row-normalized,
-        # with the same scale applied to the carried H P / S P)
-        cp = c.at[:nb, :].set(0.0)
-        pn = (cp.T @ v) * mask
-        pscale = 1.0 / jnp.maximum(jnp.linalg.norm(pn, axis=1, keepdims=True), 1e-30)
+        with jax.named_scope("davidson_inner"):
+            hsub = v.conj() @ hv.T
+            ssub = v.conj() @ sv.T
+            hsub = 0.5 * (hsub + hsub.conj().T)
+            ssub = 0.5 * (ssub + ssub.conj().T)
+        with jax.named_scope("davidson_rr"):
+            e, c = _rayleigh_ritz(hsub, ssub, nb)
+        with jax.named_scope("davidson_rotate"):
+            # X' = V C and the carried H X' = (H V) C, S X' = (S V) C exactly
+            xn = (c.T @ v) * mask
+            hxn = (c.T @ hv) * mask
+            sxn = (c.T @ sv) * mask
+            # new search direction: the non-X part of the update
+            # (row-normalized, with the same scale applied to the carried
+            # H P / S P)
+            cp = c.at[:nb, :].set(0.0)
+            pn = (cp.T @ v) * mask
+            pscale = 1.0 / jnp.maximum(
+                jnp.linalg.norm(pn, axis=1, keepdims=True), 1e-30)
         return (xn, hxn, sxn, pn * pscale, (cp.T @ hv) * mask * pscale,
                 (cp.T @ sv) * mask * pscale), rnorm
 
@@ -180,10 +190,12 @@ def davidson(
         steps = min(refresh_every, num_steps - done)
         if done == 0:
             # P is exactly zero before the first chunk: only X needs applying
-            hx, sx = apply_h_s(x)
+            with jax.named_scope("davidson_hpsi"):
+                hx, sx = apply_h_s(x)
         else:
             # chunk-boundary refresh: true H/S application to [X; P]
-            hxp, sxp = apply_h_s(jnp.concatenate([x, p], axis=0))
+            with jax.named_scope("davidson_hpsi"):
+                hxp, sxp = apply_h_s(jnp.concatenate([x, p], axis=0))
             hx, sx = hxp[:nb], sxp[:nb]
             hp, sp = hxp[nb:], sxp[nb:]
         (x, hx, sx, p, hp, sp), rhist = jax.lax.scan(
@@ -192,7 +204,8 @@ def davidson(
         done += steps
     # fresh application for the exit values: the carried H X accumulates
     # linear-combination rounding (matters in c64)
-    hx, sx = apply_h_s(x)
+    with jax.named_scope("davidson_hpsi"):
+        hx, sx = apply_h_s(x)
     den = jnp.real(jnp.sum(x.conj() * sx, axis=1))
     evals = jnp.real(jnp.sum(x.conj() * hx, axis=1)) / jnp.where(
         jnp.abs(den) > 1e-30, den, 1.0

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Perf regression gate — thin wrapper over sirius_tpu.obs.perf (also
+installed as the `sirius-bench` console script).
+
+Typical flows::
+
+    # record / extend the checked-in baseline time series
+    python tools/bench_regress.py --tiers small,large --update PERF_BASELINE.json
+
+    # gate a candidate change (nonzero exit on regression)
+    python tools/bench_regress.py --compare PERF_BASELINE.json
+
+    # CI mode: tiny deck, machine-independent stage shares, 2x floor
+    python tools/bench_regress.py --tiers small --repeats 2 --normalize \
+        --min-ratio 2.0 --compare PERF_BASELINE.json --out perf_gate.json
+"""
+
+import sys
+
+from sirius_tpu.obs.perf import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,40 @@ def deck_mix(num_jobs: int) -> list[dict]:
     return mix
 
 
+# metric families worth keeping in the reviewable artifact; everything
+# else (per-span histograms, device gauges, ...) needs --full-obs
+OBS_WHITELIST = (
+    "serve_job_run_seconds",
+    "serve_job_retries_total",
+    "serve_job_failures_total",
+    "jax_backend_compiles_total",
+    "scf_iterations_total",
+    "scf_iteration_seconds",
+)
+
+
+def summarize_registry(registry: dict, whitelist=OBS_WHITELIST) -> dict:
+    """Condense a metrics snapshot for the JSON artifact: whitelisted
+    families only, histograms reduced to {labels, count, sum} (bucket
+    vectors dropped). The full registry grew SERVE_BENCH.json to ~770
+    lines; this keeps the artifact reviewable in a diff."""
+    out = {}
+    for fam, body in registry.items():
+        if fam not in whitelist:
+            continue
+        samples = []
+        for s in body.get("samples", []):
+            if body.get("type") == "histogram":
+                samples.append({"labels": s.get("labels", {}),
+                                "count": s.get("count"),
+                                "sum": s.get("sum")})
+            else:
+                samples.append({"labels": s.get("labels", {}),
+                                "value": s.get("value")})
+        out[fam] = {"type": body.get("type"), "samples": samples}
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jobs", type=int, default=8)
@@ -77,6 +111,9 @@ def main(argv=None) -> int:
                          " >1 per slice keeps the fused/exec-cache path on")
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--full-obs", action="store_true",
+                    help="embed the FULL metrics registry in the artifact "
+                         "instead of the whitelisted summary")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -120,14 +157,17 @@ def main(argv=None) -> int:
         "cache": stats["cache"],
         "retries_total": stats["retries_total"],
         # final observability snapshot: compile counts, queue high-water,
-        # per-bucket latency histograms — the full registry dump
+        # per-bucket latency histograms — whitelisted summary by default,
+        # the full registry dump behind --full-obs
         "obs": {
             "backend_compiles_total": obs_snap["backend_compiles_total"],
             "queue_depth_high_water": obs_snap["queue_depth_high_water"],
             "cache_hit_rate": stats["cache"]["hit_rate"],
             "latency_by_bucket": obs_snap["registry"].get(
                 "serve_job_run_seconds", {}).get("samples", []),
-            "registry": obs_snap["registry"],
+            "registry": (obs_snap["registry"] if args.full_obs
+                         else summarize_registry(obs_snap["registry"])),
+            "registry_full": bool(args.full_obs),
         },
         "events_log": os.path.join(workdir, "events.jsonl"),
         "per_job": [j.to_dict() for j in eng._submitted],
