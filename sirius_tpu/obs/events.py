@@ -18,6 +18,13 @@ Event kinds emitted across the tree:
 - ``md_step``        — per MD step: energies, drift, scf_iterations,
   extrapolation error
 - ``job_transition`` — serve job lifecycle (queued→…→done|failed|aborted)
+- ``backoff``        — serve retry backoff: delay_s, attempt, failure_class
+- ``watchdog_fire``  — slice watchdog detection (kind=crash|hang)
+- ``worker_restart`` — slice worker respawned (reason, generation)
+- ``quarantine``     — job permanently failed as poison (strikes)
+- ``journal_replay`` / ``journal_replay_job`` — jobs re-submitted from the
+  durable job journal after a restart (serve/journal.py)
+- ``drain`` / ``abort`` — engine shutdown handing queued jobs back
 - ``trace_capture``  — profiler trace start/stop with the output dir
 
 Unconfigured, ``emit`` is one attribute test — safe on every hot path.

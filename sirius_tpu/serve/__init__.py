@@ -6,18 +6,31 @@ Pederson et al. arXiv:2202.01255): decks whose padded shapes match share
 jitted FusedScf/Davidson executables, and the global device mesh is
 partitioned into slices that each run one job at a time.
 
+The serving layer is fault-tolerant (ISSUE 8): a durable JSONL job
+journal (serve/journal.py) makes submissions and outcomes survive
+``kill -9`` with replay-and-resume on restart; slice workers run under a
+supervisor watchdog (serve/supervisor.py) that respawns dead or hung
+workers and quarantines poison jobs; retries back off exponentially
+(deadline-aware) and admission is bounded (QueueFullError).
+
 Entry points: ServeEngine (library), `sirius-serve` (CLI, serve.engine),
-tools/loadgen.py (throughput/latency benchmark).
+tools/loadgen.py (throughput/latency benchmark), tools/chaos_serve.py
+(kill/restart/hang chaos gauntlet -> CHAOS_BENCH.json).
 """
 
 from sirius_tpu.serve.cache import ExecutableCache
-from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.serve.journal import JobJournal
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus, QueueFullError
 from sirius_tpu.serve.scheduler import SliceScheduler
+from sirius_tpu.serve.supervisor import SliceSupervisor
 
 __all__ = [
     "ExecutableCache",
     "Job",
+    "JobJournal",
     "JobQueue",
     "JobStatus",
+    "QueueFullError",
     "SliceScheduler",
+    "SliceSupervisor",
 ]

@@ -1,18 +1,31 @@
-"""ServeEngine: queue + executable cache + slice scheduler, and the
-`sirius-serve` CLI.
+"""ServeEngine: queue + executable cache + slice scheduler + durable job
+journal, and the `sirius-serve` CLI.
 
 Library use::
 
-    eng = ServeEngine(num_slices=4)
+    eng = ServeEngine(num_slices=4, journal_path="jobs.journal")
     eng.start()
     job = eng.submit(deck_dict, priority=1)
     job.wait()
-    eng.shutdown()
+    eng.shutdown(mode="drain")
     print(eng.stats())
 
 CLI use: ``sirius-serve deck1.json deck2.json ... [--slices N]`` runs the
 decks to completion and prints a JSON stats report (the same shape
 tools/loadgen.py writes to SERVE_BENCH.json).
+
+Fault tolerance (ISSUE 8): with ``journal_path`` set, every accepted
+submission and terminal transition is fsync'd to an append-only JSONL
+write-ahead journal (serve/journal.py) *before* the engine acts on it. A
+new engine pointed at the same journal replays the jobs that never
+reached a terminal state, re-submitting them with ``resume_path`` aimed
+at their job-scoped autosaves — a ``kill -9`` mid-campaign costs only
+the SCF iterations since each job's last autosave. ``shutdown`` knows
+``drain`` (stop admissions, finish in-flight, leave queued jobs in the
+journal for the next process) from ``abort`` (queued jobs are terminally
+aborted and journaled as such); the CLI maps SIGTERM to a drain and
+exits 0. Slice workers are supervised with heartbeats, a watchdog, and
+poison quarantine (serve/supervisor.py).
 
 Observability: ``metrics_port`` starts the obs HTTP endpoint
 (``/metrics`` Prometheus text, ``/healthz`` JSON, ``/debug/trace`` to arm
@@ -27,13 +40,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 from sirius_tpu import obs
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.serve import journal as journal_mod
 from sirius_tpu.serve.cache import ExecutableCache
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
 from sirius_tpu.serve.scheduler import SliceScheduler
+
+_REPLAYS = obs_metrics.REGISTRY.counter(
+    "serve_journal_replays_total", "jobs replayed from the journal")
 
 
 def _percentile(xs, q: float) -> float:
@@ -48,14 +68,23 @@ class ServeEngine:
                  cache_capacity: int = 32, autosave_every: int = 3,
                  autosave_keep: int = 2, workdir: str = ".",
                  verbose: bool = False, metrics_port: int | None = None,
-                 events_path: str | None = None):
-        self.queue = JobQueue()
+                 events_path: str | None = None,
+                 journal_path: str | None = None, queue_maxsize: int = 0,
+                 poison_threshold: int = 2,
+                 job_wall_time_budget: float | None = None,
+                 watchdog_interval: float = 0.25,
+                 backoff_base: float = 0.5, backoff_max: float = 30.0):
+        self.queue = JobQueue(maxsize=queue_maxsize)
         self.cache = ExecutableCache(capacity=cache_capacity)
         self.workdir = workdir
+        self.autosave_keep = int(autosave_keep)
         self.scheduler = SliceScheduler(
             self.queue, self.cache, num_slices=num_slices, devices=devices,
             autosave_every=autosave_every, autosave_keep=autosave_keep,
-            verbose=verbose,
+            verbose=verbose, poison_threshold=poison_threshold,
+            job_wall_time_budget=job_wall_time_budget,
+            watchdog_interval=watchdog_interval,
+            backoff_base=backoff_base, backoff_max=backoff_max,
         )
         self._t0: float | None = None
         self._submitted: list[Job] = []
@@ -63,14 +92,68 @@ class ServeEngine:
         self._obs_server = None
         if events_path:
             obs.configure_events(events_path)
+        self.journal: journal_mod.JobJournal | None = None
+        self.replayed: list[Job] = []
+        if journal_path:
+            pending, jstats = journal_mod.replay(journal_path)
+            self.journal = journal_mod.JobJournal(journal_path)
+            self._journal_stats = jstats
+            for rec in pending:
+                self.replayed.append(self._replay_job(rec))
         if metrics_port is not None:
-            import os
-
             from sirius_tpu.obs.http import ObsHttpServer
             self._obs_server = ObsHttpServer(
                 port=metrics_port, health_fn=self._health,
                 default_trace_dir=os.path.join(workdir, "trace_capture"),
             )
+
+    # -- journal -----------------------------------------------------------
+
+    def _journal_terminal(self, job: Job) -> None:
+        """Job terminal hook: make the outcome durable. Drained jobs are
+        deliberately left non-terminal so a restart re-runs them."""
+        if self.journal is None or job.leave_in_journal:
+            return
+        self.journal.record_terminal(job)
+
+    def _replay_job(self, rec: dict) -> Job:
+        """Re-submit one non-terminal journal record, resuming from the
+        newest valid generation of its job-scoped autosave."""
+        job = Job(
+            rec.get("deck") or {}, job_id=rec["job_id"],
+            base_dir=rec.get("base_dir") or self.workdir,
+            priority=int(rec.get("priority") or 0),
+            deadline=rec.get("deadline"),
+            max_retries=int(rec.get("max_retries") or 2),
+            wall_time_budget=rec.get("wall_time_budget"),
+        )
+        job.resume_path = self._find_replay_autosave(job)
+        job._on_terminal = self._journal_terminal
+        job.submitted_at = rec.get("ts") or time.time()
+        self._submitted.append(job)
+        # requeue, not submit: the journal already admitted this work, so
+        # it is exempt from the admission bound and not re-journaled
+        self.queue.requeue(job, "journal replay")
+        _REPLAYS.inc()
+        obs_events.emit("journal_replay_job", job_id=job.id,
+                        resume=job.resume_path)
+        return job
+
+    def _find_replay_autosave(self, job: Job) -> str | None:
+        from sirius_tpu.io.checkpoint import find_resumable
+
+        ctl = {}
+        if isinstance(job.deck, dict):
+            ctl = job.deck.get("control") or {}
+        # mirror the scheduler's serve defaults: explicit autosave_path
+        # wins, then the (tag or job-id)-scoped rotation in base_dir
+        base = ctl.get("autosave_path") or os.path.join(
+            job.base_dir,
+            f"sirius_autosave.{ctl.get('autosave_tag') or job.id}.h5")
+        try:
+            return find_resumable(base, keep=self.autosave_keep)
+        except Exception:
+            return None
 
     @property
     def num_slices(self) -> int:
@@ -96,18 +179,40 @@ class ServeEngine:
             "jobs_submitted": len(self._submitted),
             "jobs_in_flight": sum(
                 j.status not in terminal for j in self._submitted),
+            "journal": self.journal.path if self.journal else None,
+            "jobs_replayed": len(self.replayed),
             "uptime_s": (time.time() - self._t0) if self._t0 else 0.0,
         }
 
     def submit(self, deck: dict, job_id: str | None = None,
                priority: int = 0, deadline: float | None = None,
-               base_dir: str | None = None, max_retries: int = 2) -> Job:
+               base_dir: str | None = None, max_retries: int = 2,
+               wall_time_budget: float | None = None,
+               block: bool = False, timeout: float | None = None) -> Job:
+        """Admit a job. Raises QueueFullError when the queue is bounded
+        and full (immediately, or after ``timeout`` with ``block=True``).
+        With a journal, the submission is durable before it is queued."""
         job = Job(
             deck, job_id=job_id, base_dir=base_dir or self.workdir,
             priority=priority, deadline=deadline, max_retries=max_retries,
+            wall_time_budget=wall_time_budget,
         )
+        if self.journal is not None:
+            job._on_terminal = self._journal_terminal
+            # write-ahead: journal first so a crash between journaling and
+            # queueing re-runs the job (at-least-once) instead of losing it
+            job.submitted_at = time.time()
+            self.journal.record_submit(job)
+        try:
+            self.queue.submit(job, block=block, timeout=timeout)
+        except Exception as e:
+            # keep the journal consistent: the rejection is terminal (the
+            # _on_terminal hook writes the terminal record)
+            job.error = f"rejected: {e}"
+            job._transition(JobStatus.ABORTED, job.error)
+            raise
         self._submitted.append(job)
-        return self.queue.submit(job)
+        return job
 
     def wait_all(self, timeout: float | None = None) -> bool:
         """Block until every submitted job is terminal. False on timeout."""
@@ -120,13 +225,40 @@ class ServeEngine:
                 return False
         return True
 
-    def shutdown(self, wait: bool = True, cleanup: bool = True) -> None:
+    def shutdown(self, wait: bool = True, cleanup: bool = True,
+                 mode: str = "drain") -> None:
+        """Stop the engine.
+
+        ``mode="drain"``: stop admissions, let in-flight jobs finish, and
+        hand queued-but-unstarted jobs back to the journal (terminal
+        ABORTED in-process so ``wait_all`` returns, but left non-terminal
+        on disk with their autosaves intact — the next engine on this
+        journal re-runs them). ``mode="abort"``: queued jobs are
+        terminally aborted, in the journal too."""
+        if mode not in ("drain", "abort"):
+            raise ValueError(f"shutdown mode must be drain|abort, not {mode!r}")
         self._shutdown = True
         self.queue.close()
+        drained = self.queue.abort_pending(
+            "drained for restart" if mode == "drain" else "abort shutdown",
+            leave_in_journal=(mode == "drain" and self.journal is not None),
+        )
+        if drained:
+            obs_events.emit("drain" if mode == "drain" else "abort",
+                            jobs=[j.id for j in drained])
         if wait:
             self.scheduler.join(timeout=60.0)
+        self.scheduler.stop_supervision()
+        # deterministic close: nothing a dead/raced worker left behind may
+        # stay QUEUED forever (wait_all would block on it)
+        self.queue.abort_pending(
+            "queue closed before worker pickup",
+            leave_in_journal=(mode == "drain" and self.journal is not None),
+        )
         if cleanup:
             self.scheduler.cleanup_autosaves(self._submitted)
+        if self.journal is not None:
+            self.journal.close()
         if self._obs_server is not None:
             self._obs_server.stop()
 
@@ -141,6 +273,11 @@ class ServeEngine:
                 j.status == JobStatus.FAILED for j in self._submitted),
             "num_aborted": sum(
                 j.status == JobStatus.ABORTED for j in self._submitted),
+            "num_quarantined": sum(
+                j.quarantined for j in self._submitted),
+            "num_replayed": len(self.replayed),
+            "num_drained": sum(
+                j.leave_in_journal for j in self._submitted),
             "num_slices": self.num_slices,
             "wall_s": wall,
             "jobs_per_min": (len(done) / wall * 60.0) if wall > 0 else 0.0,
@@ -186,13 +323,22 @@ def main(argv: list[str] | None = None) -> int:
                         "(0 = ephemeral; off when omitted)")
     p.add_argument("--events", default=None,
                    help="append JSONL observability events to this file")
+    p.add_argument("--journal", default=None,
+                   help="durable job journal (JSONL WAL); a restart with "
+                        "the same path resumes unfinished jobs")
+    p.add_argument("--queue-max", type=int, default=0,
+                   help="bound the queue (0 = unbounded); full queues "
+                        "reject submissions")
+    p.add_argument("--budget", type=float, default=None,
+                   help="per-attempt wall-time budget in seconds enforced "
+                        "by the slice watchdog")
+    p.add_argument("--poison-threshold", type=int, default=2,
+                   help="worker-fatal strikes before a job is quarantined")
     p.add_argument("-v", "--verbose", action="count", default=0,
                    help="raise log level (-v info, -vv debug)")
     args = p.parse_args(argv)
 
     obs.setup_logging(args.verbose)
-
-    import os
 
     for d in args.decks:
         if not os.path.isfile(d):
@@ -207,13 +353,36 @@ def main(argv: list[str] | None = None) -> int:
             "axon" if args.platform == "tpu" else args.platform,
         )
 
+    import signal
+    import threading
+
     eng = ServeEngine(num_slices=args.slices, verbose=True,
                       metrics_port=args.metrics_port,
-                      events_path=args.events)
+                      events_path=args.events,
+                      journal_path=args.journal,
+                      queue_maxsize=args.queue_max,
+                      job_wall_time_budget=args.budget,
+                      poison_threshold=args.poison_threshold)
+    drain = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        # graceful drain: stop accepting, finish in-flight, leave the
+        # rest (journaled) for the next process, exit 0
+        print("sirius-serve: SIGTERM — draining", file=sys.stderr)
+        drain.set()
+        eng.queue.close()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     eng.start()
     if eng.metrics_url:
         print(f"sirius-serve: metrics at {eng.metrics_url}/metrics",
               file=sys.stderr)
+    if eng.replayed:
+        print(f"sirius-serve: replayed {len(eng.replayed)} unfinished "
+              f"job(s) from {args.journal}", file=sys.stderr)
     for rep in range(args.repeat):
         for path in args.decks:
             with open(path) as f:
@@ -224,10 +393,16 @@ def main(argv: list[str] | None = None) -> int:
                 deadline=(time.time() + args.deadline
                           if args.deadline else None),
                 base_dir=os.path.dirname(os.path.abspath(path)) or ".",
+                wall_time_budget=args.budget,
             )
-    ok = eng.wait_all(timeout=args.timeout)
+    bar = time.time() + args.timeout
+    ok = False
+    while not drain.is_set():
+        ok = eng.wait_all(timeout=0.5)
+        if ok or time.time() > bar:
+            break
     stats_obs = eng.metrics_snapshot()
-    eng.shutdown(wait=True)
+    eng.shutdown(wait=True, mode="drain")
     stats = eng.stats()
     stats["obs"] = {k: v for k, v in stats_obs.items() if k != "stats"}
     stats["jobs"] = [j.to_dict() for j in eng._submitted]
@@ -235,6 +410,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.stats_out:
         with open(args.stats_out, "w") as f:
             json.dump(stats, f, indent=2, default=float)
+    if drain.is_set():
+        print(f"sirius-serve: drained ({stats['num_drained']} job(s) left "
+              f"in the journal)", file=sys.stderr)
+        return 0
     if not ok:
         print("sirius-serve: timed out waiting for jobs", file=sys.stderr)
         return 3

@@ -1,0 +1,168 @@
+"""Durable job journal: an append-only JSONL write-ahead log for the
+serving engine.
+
+Every accepted submission and every terminal transition is appended as
+one JSON line and fsync'd before the engine acts on it, so the set of
+jobs the engine owes an answer for survives ``kill -9``. On restart,
+``replay()`` folds the log into the jobs that were submitted but never
+reached a terminal state — exactly the ones a fresh ``ServeEngine``
+must re-run (resuming from their job-scoped autosaves, which is why a
+replayed job costs only the iterations since its last autosave, not a
+full SCF).
+
+Record kinds::
+
+    {"kind": "submit",   "job_id", "deck", "base_dir", "priority",
+     "deadline", "max_retries", "wall_time_budget", "ts"}
+    {"kind": "terminal", "job_id", "status", "error", "permanent", "ts"}
+
+Crash-safety contract:
+
+- **Atomic appends.** A record is one ``write()`` of one newline-
+  terminated line, flushed and ``os.fsync``'d before ``append`` returns.
+  A crash leaves at most one torn (partial, newline-less) line at the
+  tail — never an interleaved or half-overwritten record.
+- **Torn-tail-tolerant replay.** ``replay`` skips unparseable lines
+  (counting them) instead of failing: a torn ``submit`` means the engine
+  never acknowledged the job; a torn ``terminal`` means the job re-runs —
+  at-least-once semantics, which SCF resume makes cheap and idempotent.
+- **Tail repair on reopen.** Opening a journal whose last line is torn
+  first writes a lone ``\\n`` so the next append cannot glue onto the
+  torn fragment and corrupt itself.
+
+The ``serve.journal_torn`` fault site (utils/faults.py) tears a chosen
+append mid-line — the ``iteration`` of the spec is the journal's append
+sequence number — so tests and tools/chaos_serve.py can exercise the
+replay contract without an actual crash inside ``write()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.utils import faults
+
+_RECORDS = obs_metrics.REGISTRY.counter(
+    "serve_journal_records_total", "journal appends by record kind")
+
+TERMINAL_STATUSES = ("done", "failed", "aborted")
+
+
+class JobJournal:
+    """Append-only fsync'd JSONL journal (one engine process at a time)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._appends = 0
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        self._repair_tail()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _repair_tail(self) -> None:
+        """Isolate a torn last line so future appends stay parseable."""
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                torn = fh.read(1) != b"\n"
+            if torn:
+                with open(self.path, "ab") as fh:
+                    fh.write(b"\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        except FileNotFoundError:
+            return
+
+    def append(self, rec: dict) -> None:
+        line = json.dumps(rec, default=float)
+        with self._lock:
+            seq = self._appends
+            self._appends += 1
+            if faults.armed("serve.journal_torn", seq):
+                # the on-disk state a crash inside write() leaves: a
+                # partial line, no newline, nothing durably synced
+                self._fh.write(line[: max(1, len(line) // 2)])
+                self._fh.flush()
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        _RECORDS.inc(kind=rec.get("kind", "unknown"))
+
+    def record_submit(self, job) -> None:
+        self.append({
+            "kind": "submit",
+            "job_id": job.id,
+            "deck": job.deck,
+            "base_dir": job.base_dir,
+            "priority": job.priority,
+            "deadline": job.deadline,
+            "max_retries": job.max_retries,
+            "wall_time_budget": job.wall_time_budget,
+            "ts": job.submitted_at,
+        })
+
+    def record_terminal(self, job) -> None:
+        self.append({
+            "kind": "terminal",
+            "job_id": job.id,
+            "status": job.status,
+            "error": job.error,
+            "permanent": job.permanent,
+            "ts": job.finished_at,
+        })
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def replay(path: str) -> tuple[list[dict], dict]:
+    """Fold a journal into its non-terminal submissions.
+
+    Returns ``(pending, stats)``: ``pending`` is the submit records (in
+    original submit order, duplicates collapsed to the newest) that have
+    no terminal record after them; ``stats`` counts what was seen. Never
+    raises on a torn/garbled line — those are counted in
+    ``stats["torn_lines"]`` and skipped.
+    """
+    pending: dict[str, dict] = {}
+    stats = {"submits": 0, "terminals": 0, "torn_lines": 0}
+    if not os.path.exists(path):
+        return [], stats
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                stats["torn_lines"] += 1
+                continue
+            kind = rec.get("kind")
+            job_id = rec.get("job_id")
+            if not job_id:
+                stats["torn_lines"] += 1
+                continue
+            if kind == "submit":
+                stats["submits"] += 1
+                pending[job_id] = rec
+            elif kind == "terminal":
+                stats["terminals"] += 1
+                pending.pop(job_id, None)
+    out = list(pending.values())
+    if out:
+        obs_events.emit("journal_replay", path=str(path),
+                        pending=[r["job_id"] for r in out], **stats)
+    return out, stats

@@ -1,4 +1,5 @@
-"""Priority job queue with deadlines and per-job lifecycle events.
+"""Priority job queue with deadlines, admission control, retry backoff
+and per-job lifecycle events.
 
 Jobs carry the same JSON deck dict that cli.py consumes. Lifecycle:
 queued -> compiling -> running -> done | failed | aborted; every
@@ -8,6 +9,30 @@ pops first; among equal priorities the earlier ``deadline`` (then FIFO
 order) wins. A job whose deadline has already passed when it reaches the
 front is aborted instead of run — serving semantics: a late answer is a
 wrong answer.
+
+Fault-tolerance semantics (ISSUE 8):
+
+- **Backoff.** ``job.not_before`` is an absolute wall-clock bar that
+  ``pop()`` honors: a retried job sleeps *in the queue* (the worker is
+  free to run other jobs) until its backoff expires. The scheduler
+  clamps ``not_before`` to the job deadline, so backoff can never push a
+  job past the point where it would be aborted unrun.
+- **Admission control.** ``JobQueue(maxsize=N)`` bounds the number of
+  queued entries; ``submit`` either rejects immediately with
+  ``QueueFullError`` or, with ``block=True``, waits up to ``timeout``
+  for space. ``requeue`` (retries, watchdog hand-backs, journal replays)
+  bypasses the bound — work the engine already accepted is never
+  rejected.
+- **Deterministic close.** ``close()`` stops admissions; blocked
+  ``pop()`` calls drain then return None. ``abort_pending()`` empties
+  the heap and transitions every entry terminally — the engine calls it
+  on ``drain``/``abort`` shutdown and again after the workers have
+  exited, so a close racing a worker's exit can never strand a job in
+  QUEUED with ``wait_all()`` blocked on it.
+- **Terminal transitions are final.** ``Job._transition`` ignores any
+  transition after done/failed/aborted — a hung worker abandoned by the
+  watchdog cannot resurrect or clobber a job that was already requeued,
+  quarantined, or drained.
 """
 
 from __future__ import annotations
@@ -19,6 +44,9 @@ import time
 
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger
+
+logger = get_logger("serve")
 
 _TRANSITIONS = obs_metrics.REGISTRY.counter(
     "serve_job_transitions_total", "job lifecycle transitions by status")
@@ -30,6 +58,12 @@ _DEPTH = obs_metrics.REGISTRY.gauge(
     "serve_queue_depth", "jobs waiting in the queue")
 _DEPTH_HW = obs_metrics.REGISTRY.gauge(
     "serve_queue_depth_high_water", "max queue depth seen this process")
+_REJECTED = obs_metrics.REGISTRY.counter(
+    "serve_queue_rejected_total", "submissions rejected by admission control")
+
+
+class QueueFullError(RuntimeError):
+    """The bounded queue rejected a submission (admission control)."""
 
 
 class JobStatus:
@@ -41,31 +75,55 @@ class JobStatus:
     ABORTED = "aborted"
 
 
+TERMINAL = (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED)
+
+
 class Job:
     """One SCF request: a deck dict plus scheduling metadata."""
 
     def __init__(self, deck: dict, job_id: str | None = None,
                  base_dir: str = ".", priority: int = 0,
-                 deadline: float | None = None, max_retries: int = 2):
+                 deadline: float | None = None, max_retries: int = 2,
+                 wall_time_budget: float | None = None):
         self.id = job_id or f"job-{id(self):x}"
         self.deck = deck
         self.base_dir = base_dir
         self.priority = int(priority)
         self.deadline = deadline  # absolute time.time() bar, None = none
         self.max_retries = int(max_retries)
+        # per-attempt wall-time budget enforced by the supervisor watchdog
+        # (None falls back to the scheduler default; 0/None = unbounded)
+        self.wall_time_budget = wall_time_budget
         self.status = JobStatus.QUEUED
         self.events: list[tuple[float, str, str]] = []
         self.result: dict | None = None
         self.error: str | None = None
         self.permanent = False  # classified non-retryable (bad input)
+        self.quarantined = False  # poisoned: killed/stalled its workers
         self.attempts = 0
+        self.poison_strikes = 0  # watchdog strikes (crash/hang) against it
         self.resume_path: str | None = None  # autosave to resume from
+        self.not_before: float | None = None  # backoff bar honored by pop()
         self.submitted_at: float | None = None
         self.started_at: float | None = None
         self.finished_at: float | None = None
+        # drained jobs are terminal in-process but deliberately left
+        # non-terminal in the journal so a restart re-runs them
+        self.leave_in_journal = False
+        # bumped when the watchdog takes the job away from a worker;
+        # workers capture the epoch at pickup and discard stale results
+        self._epoch = 0
+        self._cfg = None  # parsed Config cached by the scheduler (retries)
+        self._on_terminal = None  # engine hook (journal terminal record)
         self._done = threading.Event()
 
     def _transition(self, status: str, detail: str = "") -> None:
+        if self.status in TERMINAL:
+            # final means final: an abandoned worker finishing late, or a
+            # drain racing a retry, must not resurrect a settled job
+            logger.debug("job %s: ignoring %s after terminal %s",
+                         self.id, status, self.status)
+            return
         now = time.time()
         if self.events:
             prev_t, prev_status, _ = self.events[-1]
@@ -75,15 +133,24 @@ class Job:
         _TRANSITIONS.inc(status=status)
         obs_events.emit("job_transition", job_id=self.id, status=status,
                         detail=detail, attempt=self.attempts)
-        if status in (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED):
+        if status in TERMINAL:
             self.finished_at = now
             if self.submitted_at is not None:
                 _LATENCY.observe(now - self.submitted_at, outcome=status)
+            if self._on_terminal is not None:
+                try:
+                    self._on_terminal(self)
+                except Exception:
+                    logger.exception("job %s terminal hook failed", self.id)
             self._done.set()
 
     def wait(self, timeout: float | None = None) -> bool:
         """Block until the job reaches a terminal status."""
         return self._done.wait(timeout)
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in TERMINAL
 
     @property
     def latency(self) -> float | None:
@@ -98,9 +165,11 @@ class Job:
             "status": self.status,
             "priority": self.priority,
             "attempts": self.attempts,
+            "poison_strikes": self.poison_strikes,
             "latency_s": self.latency,
             "error": self.error,
             "permanent": self.permanent,
+            "quarantined": self.quarantined,
             "events": [
                 {"t": t, "status": s, "detail": d} for t, s, d in self.events
             ],
@@ -109,16 +178,23 @@ class Job:
 
 class JobQueue:
     """Thread-safe priority queue (highest priority first, then earliest
-    deadline, then submit order)."""
+    deadline, then submit order), with optional bounded admission."""
 
-    def __init__(self):
+    def __init__(self, maxsize: int = 0):
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
         self._heap: list[tuple] = []
         self._seq = itertools.count()
         self._closed = False
+        self.maxsize = int(maxsize)  # 0 = unbounded
         self.jobs: dict[str, Job] = {}
         self.high_water = 0
+
+    @property
+    def closed(self) -> bool:
+        """True once close() was called (no further admissions)."""
+        return self._closed
 
     def _depth_changed_locked(self) -> None:
         depth = len(self._heap)
@@ -127,69 +203,134 @@ class JobQueue:
         _DEPTH.set(depth)
         _DEPTH_HW.max(depth)
 
-    def submit(self, job: Job) -> Job:
+    def _push_locked(self, job: Job) -> None:
+        heapq.heappush(self._heap, (
+            -job.priority,
+            job.deadline if job.deadline is not None else float("inf"),
+            next(self._seq),
+            job,
+        ))
+        self._depth_changed_locked()
+        self._not_empty.notify()
+
+    def submit(self, job: Job, block: bool = False,
+               timeout: float | None = None) -> Job:
+        """Admit a new job. A bounded queue that is full rejects with
+        QueueFullError immediately (``block=False``) or after waiting up
+        to ``timeout`` seconds for space (``block=True``)."""
+        bar = None if timeout is None else time.time() + timeout
         with self._not_empty:
             if self._closed:
                 raise RuntimeError("queue is closed")
+            while self.maxsize and len(self._heap) >= self.maxsize:
+                if not block:
+                    _REJECTED.inc(mode="immediate")
+                    raise QueueFullError(
+                        f"queue full ({len(self._heap)}/{self.maxsize})")
+                remaining = None if bar is None else bar - time.time()
+                if remaining is not None and remaining <= 0:
+                    _REJECTED.inc(mode="timeout")
+                    raise QueueFullError(
+                        f"queue full ({len(self._heap)}/{self.maxsize}) "
+                        f"after {timeout}s")
+                self._not_full.wait(remaining)
+                if self._closed:
+                    raise RuntimeError("queue is closed")
             job.submitted_at = time.time()
             job._transition(JobStatus.QUEUED)
             self.jobs[job.id] = job
-            heapq.heappush(self._heap, (
-                -job.priority,
-                job.deadline if job.deadline is not None else float("inf"),
-                next(self._seq),
-                job,
-            ))
-            self._depth_changed_locked()
-            self._not_empty.notify()
+            self._push_locked(job)
         return job
 
     def requeue(self, job: Job, detail: str = "") -> None:
-        """Put a transiently-failed job back (retry/resume path)."""
+        """Put a transiently-failed job back (retry/resume/replay path).
+        Exempt from the admission bound: this work was already accepted."""
+        if job.terminal:
+            return  # quarantined/drained while the retry was in flight
         with self._not_empty:
             if self._closed:
                 job._transition(JobStatus.ABORTED, "queue closed")
                 return
             job._transition(JobStatus.QUEUED, detail)
-            heapq.heappush(self._heap, (
-                -job.priority,
-                job.deadline if job.deadline is not None else float("inf"),
-                next(self._seq),
-                job,
-            ))
-            self._depth_changed_locked()
-            self._not_empty.notify()
+            self.jobs.setdefault(job.id, job)
+            self._push_locked(job)
 
     def pop(self, timeout: float | None = None) -> Job | None:
         """Next runnable job; None on timeout or when closed and drained.
-        Deadline-expired jobs are aborted here, never returned."""
-        deadline = None if timeout is None else time.time() + timeout
+        Deadline-expired jobs are aborted here, never returned; jobs whose
+        backoff bar (``not_before``) is still in the future stay queued."""
+        bar = None if timeout is None else time.time() + timeout
         with self._not_empty:
             while True:
+                now = time.time()
+                deferred: list[tuple] = []
+                picked: Job | None = None
+                next_ready: float | None = None
                 while self._heap:
-                    _, _, _, job = heapq.heappop(self._heap)
-                    self._depth_changed_locked()
-                    if (job.deadline is not None
-                            and time.time() > job.deadline):
+                    entry = heapq.heappop(self._heap)
+                    job = entry[3]
+                    if (job.deadline is not None and now > job.deadline):
+                        self._depth_changed_locked()
+                        self._not_full.notify()
                         job._transition(
                             JobStatus.ABORTED, "deadline expired in queue")
                         continue
-                    return job
-                if self._closed:
+                    if job.not_before is not None and job.not_before > now:
+                        deferred.append(entry)
+                        if next_ready is None or job.not_before < next_ready:
+                            next_ready = job.not_before
+                        continue
+                    picked = job
+                    break
+                for entry in deferred:
+                    heapq.heappush(self._heap, entry)
+                if picked is not None:
+                    self._depth_changed_locked()
+                    self._not_full.notify()
+                    return picked
+                if self._closed and not self._heap:
                     return None
-                if deadline is None:
+                # nothing runnable: wait for a submit, a backoff expiry,
+                # or the caller's timeout — whichever comes first
+                wait_until = bar
+                if next_ready is not None:
+                    wait_until = (next_ready if wait_until is None
+                                  else min(wait_until, next_ready))
+                if wait_until is None:
                     self._not_empty.wait()
                 else:
-                    remaining = deadline - time.time()
-                    if remaining <= 0 or not self._not_empty.wait(remaining):
+                    remaining = wait_until - time.time()
+                    expired = remaining <= 0 or not self._not_empty.wait(
+                        remaining)
+                    if expired and bar is not None and time.time() >= bar:
                         return None
+
+    def abort_pending(self, detail: str,
+                      leave_in_journal: bool = False) -> list[Job]:
+        """Pop and terminally abort every queued entry (drain/abort
+        shutdown, and the post-join safety net against close/worker-exit
+        races). With ``leave_in_journal`` the jobs stay non-terminal in
+        the engine journal so a restart re-runs them."""
+        with self._not_empty:
+            entries = self._heap
+            self._heap = []
+            self._depth_changed_locked()
+            self._not_full.notify_all()
+        out = []
+        for entry in sorted(entries):
+            job = entry[3]
+            job.leave_in_journal = leave_in_journal
+            job._transition(JobStatus.ABORTED, detail)
+            out.append(job)
+        return out
 
     def close(self) -> None:
         """Stop accepting work; blocked pop() calls drain then return
-        None."""
+        None, blocked submit() calls fail."""
         with self._not_empty:
             self._closed = True
             self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def __len__(self) -> int:
         with self._lock:

@@ -9,29 +9,45 @@ with a job-scoped autosave path, so a failed or preempted job is retried
 and *resumed* from its newest valid autosave rather than restarted.
 
 Failure classification:
-  transient  -> requeue (up to job.max_retries), resuming from autosave:
-               SimulatedKill (injected preemption), ScfAbortError
+  transient  -> requeue (up to job.max_retries) with exponential backoff
+               (``job.not_before``, jittered, never past the deadline),
+               resuming from autosave: SimulatedKill (injected
+               preemption, class ``preempted``), ScfAbortError
                (supervisor ladder exhausted — a rollback snapshot may
-               still converge from the autosave), CheckpointError (bad
-               autosave: the resume path is cleared first), OSError.
+               still converge from the autosave, class ``scf_abort``),
+               CheckpointError (bad autosave: the resume path is cleared
+               first, class ``bad_checkpoint``), OSError (class ``io``),
+               plus watchdog hand-backs (class ``crash``/``hang``).
   permanent  -> failed, never retried: UpfParseError and other
                ValueError/NotImplementedError/KeyError deck problems —
-               re-running bad input cannot succeed.
+               re-running bad input cannot succeed — and poison
+               quarantine (serve/supervisor.py).
+
+Workers are supervised (serve/supervisor.py): they heartbeat every poll
+cycle, register the job they run, and are respawned by the watchdog when
+they die or hang. Each attempt captures ``job._epoch`` at pickup; a
+worker whose job was taken away by the watchdog discards its outcome
+instead of clobbering the job's new life.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
+import time
 
 import numpy as np
 
+from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import spans as obs_spans
 from sirius_tpu.obs.log import get_logger, job_context
 from sirius_tpu.serve import cache as cache_mod
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.serve.supervisor import SliceSupervisor
+from sirius_tpu.utils import faults
 from sirius_tpu.utils.profiler import counters
 
 logger = get_logger("serve")
@@ -39,9 +55,11 @@ logger = get_logger("serve")
 _RUN_SECONDS = obs_metrics.REGISTRY.histogram(
     "serve_job_run_seconds", "per-attempt SCF wall time by bucket warmth")
 _RETRIES = obs_metrics.REGISTRY.counter(
-    "serve_job_retries_total", "transient-failure retries")
+    "serve_job_retries_total", "transient-failure retries by failure class")
 _FAILURES = obs_metrics.REGISTRY.counter(
     "serve_job_failures_total", "terminal job failures")
+_BACKOFF = obs_metrics.REGISTRY.histogram(
+    "serve_backoff_seconds", "retry backoff delays by failure class")
 
 # SimulationContext building for synthetic decks monkeypatches
 # UnitCell.from_config (testing.py idiom); serialize every context build
@@ -108,7 +126,12 @@ class SliceScheduler:
 
     def __init__(self, queue: JobQueue, exec_cache, num_slices: int = 1,
                  devices=None, autosave_every: int = 3,
-                 autosave_keep: int = 2, verbose: bool = False):
+                 autosave_keep: int = 2, verbose: bool = False,
+                 poison_threshold: int = 2,
+                 job_wall_time_budget: float | None = None,
+                 watchdog_interval: float = 0.25,
+                 backoff_base: float = 0.5, backoff_max: float = 30.0,
+                 backoff_jitter: float = 0.1):
         import jax
 
         self.queue = queue
@@ -124,37 +147,75 @@ class SliceScheduler:
         self.autosave_every = int(autosave_every)
         self.autosave_keep = int(autosave_keep)
         self.verbose = verbose
-        self._threads: list[threading.Thread] = []
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.backoff_jitter = float(backoff_jitter)
+        self.supervisor = SliceSupervisor(
+            self, poison_threshold=poison_threshold,
+            job_wall_time_budget=job_wall_time_budget,
+            interval=watchdog_interval,
+        )
 
     def start(self) -> None:
-        for i, devs in enumerate(self.slices):
-            t = threading.Thread(
-                target=self._worker, args=(i, devs),
-                name=f"serve-slice-{i}", daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
+        self.supervisor.start()
 
     def join(self, timeout: float | None = None) -> None:
-        for t in self._threads:
-            t.join(timeout)
+        self.supervisor.join(timeout)
+
+    def stop_supervision(self) -> None:
+        self.supervisor.stop()
 
     def _worker(self, idx: int, devs) -> None:
+        sup = self.supervisor
         while True:
+            sup.beat(idx)
             job = self.queue.pop(timeout=0.5)
             if job is None:
-                if self.queue._closed:
+                if self.queue.closed and len(self.queue) == 0:
                     return
                 continue
-            self._run_job(job, idx, devs)
+            epoch = job._epoch
+            sup.note_job(idx, job, epoch)
+            # a WorkerCrash (or any other BaseException) propagates past
+            # note_idle: the thread dies with the job still registered,
+            # which is exactly what the watchdog recovers from
+            self._run_job(job, idx, devs, epoch)
+            sup.note_idle(idx, job)
 
-    def _run_job(self, job: Job, slice_idx: int, devs) -> None:
+    def _run_job(self, job: Job, slice_idx: int, devs, epoch: int) -> None:
         job.attempts += 1
         # every log line and obs event inside the attempt carries job.id
         with job_context(job.id):
-            self._run_job_inner(job, slice_idx, devs)
+            if faults.armed("serve.worker_crash", job.attempts - 1):
+                raise faults.WorkerCrash(
+                    f"fault serve.worker_crash (job {job.id} "
+                    f"attempt {job.attempts})")
+            if faults.armed("serve.job_hang", job.attempts - 1):
+                self._hang(job, slice_idx, epoch)
+                return
+            self._run_job_inner(job, slice_idx, devs, epoch)
 
-    def _run_job_inner(self, job: Job, slice_idx: int, devs) -> None:
+    def _hang(self, job: Job, slice_idx: int, epoch: int) -> None:
+        """Simulate a wedged worker (serve.job_hang): park until the
+        watchdog abandons the job (epoch bump) — never transition it."""
+        job._transition(JobStatus.RUNNING, f"slice {slice_idx} (hung)")
+        t0 = time.time()
+        while job._epoch == epoch and time.time() - t0 < 120.0:
+            time.sleep(0.02)
+        logger.info("hung attempt of job %s unparked (%s)", job.id,
+                    "abandoned" if job._epoch != epoch else "timed out")
+
+    def _stale(self, job: Job, epoch: int) -> bool:
+        """True when the watchdog took this job away mid-attempt: the
+        outcome of the attempt must be discarded, not applied."""
+        if job._epoch != epoch:
+            logger.warning("discarding stale attempt outcome for job %s "
+                           "(abandoned by the watchdog)", job.id)
+            return True
+        return False
+
+    def _run_job_inner(self, job: Job, slice_idx: int, devs,
+                       epoch: int) -> None:
         import time as _time
 
         import jax
@@ -169,6 +230,7 @@ class SliceScheduler:
         cfg = None
         try:
             cfg = load_config(dict(job.deck))
+            job._cfg = cfg  # watchdog retries refresh the resume path
             # serve defaults: job-scoped autosaves with rotation so every
             # job is resumable and none clobbers a neighbour's checkpoint
             if not cfg.control.autosave_tag and not cfg.control.autosave_path:
@@ -223,6 +285,8 @@ class SliceScheduler:
                 "bucket_warm": warm,
                 "compiled_executables": compiled,
             }
+            if self._stale(job, epoch):
+                return
             job.result = result
             job._transition(
                 JobStatus.DONE,
@@ -230,31 +294,62 @@ class SliceScheduler:
                 f"compiled={compiled}",
             )
         except SimulatedKill as e:
-            self._retry(job, cfg, f"preempted: {e}")
+            if self._stale(job, epoch):
+                return
+            self._retry(job, cfg, f"preempted: {e}", "preempted")
         except CheckpointError as e:
+            if self._stale(job, epoch):
+                return
             # the autosave we tried to resume from is unusable: retry from
             # scratch rather than looping on the same bad file
             job.resume_path = None
-            self._retry(job, cfg, f"bad checkpoint: {e}", resume=False)
+            self._retry(job, cfg, f"bad checkpoint: {e}", "bad_checkpoint",
+                        resume=False)
         except UpfParseError as e:
+            if self._stale(job, epoch):
+                return
             self._fail(job, f"UPF parse error: {e}", permanent=True)
         except (ValueError, NotImplementedError, KeyError) as e:
+            if self._stale(job, epoch):
+                return
             self._fail(job, f"bad deck: {type(e).__name__}: {e}",
                        permanent=True)
         except ScfAbortError as e:
-            self._retry(job, cfg, f"scf aborted: {e}")
+            if self._stale(job, epoch):
+                return
+            self._retry(job, cfg, f"scf aborted: {e}", "scf_abort")
         except OSError as e:
-            self._retry(job, cfg, f"io error: {e}")
+            if self._stale(job, epoch):
+                return
+            self._retry(job, cfg, f"io error: {e}", "io")
         except Exception as e:  # a serving worker must outlive any job
+            if self._stale(job, epoch):
+                return
             self._fail(job, f"unexpected {type(e).__name__}: {e}",
                        permanent=True)
 
-    def _retry(self, job: Job, cfg, detail: str, resume: bool = True) -> None:
+    def _backoff_delay(self, job: Job) -> float:
+        """Exponential backoff with jitter, clamped so the retry can never
+        be pushed past the job's deadline (a late answer is a wrong
+        answer — better to retry sooner than to abort unrun)."""
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2.0 ** max(0, job.attempts - 1)))
+        delay *= 1.0 + self.backoff_jitter * random.random()
+        if job.deadline is not None:
+            delay = max(0.0, min(delay, job.deadline - time.time()))
+        return delay
+
+    def _retry(self, job: Job, cfg, detail: str, failure_class: str,
+               resume: bool = True) -> None:
         from sirius_tpu.dft.scf import default_autosave_path
         from sirius_tpu.io.checkpoint import find_resumable
 
+        if job.terminal:
+            return  # quarantined/drained while the attempt unwound
         counters["serve.retries"] += 1
-        _RETRIES.inc(job_id=job.id)
+        # labeled by failure class, NOT job id: one series per job is
+        # unbounded cardinality under real traffic
+        _RETRIES.inc(failure_class=failure_class)
         if job.attempts > job.max_retries:
             self._fail(job, f"{detail} (retries exhausted)")
             return
@@ -263,25 +358,55 @@ class SliceScheduler:
                 cfg, job.base_dir)
             job.resume_path = find_resumable(
                 auto, keep=int(cfg.control.autosave_keep))
+        delay = self._backoff_delay(job)
+        job.not_before = time.time() + delay
+        _BACKOFF.observe(delay, failure_class=failure_class)
+        obs_events.emit("backoff", job_id=job.id, delay_s=delay,
+                        attempt=job.attempts, failure_class=failure_class,
+                        not_before=job.not_before)
         logger.log(
             logging.INFO if self.verbose else logging.DEBUG,
-            "retrying %s: %s (resume=%s)", job.id, detail, job.resume_path)
-        self.queue.requeue(job, detail)
+            "retrying %s in %.2fs: %s (resume=%s)", job.id, delay, detail,
+            job.resume_path)
+        self.queue.requeue(job, f"{detail} (backoff {delay:.2f}s)")
 
-    def _fail(self, job: Job, detail: str, permanent: bool = False) -> None:
+    def _watchdog_retry(self, job: Job, detail: str,
+                        failure_class: str) -> None:
+        """Supervisor entry point: hand a crashed/hung worker's job back
+        to the queue with backoff, resuming from its newest autosave."""
+        self._retry(job, job._cfg, detail, failure_class)
+
+    def _fail(self, job: Job, detail: str, permanent: bool = False,
+              quarantined: bool = False) -> None:
         job.error = detail
         job.permanent = permanent
+        job.quarantined = quarantined
         counters["serve.failures"] += 1
         _FAILURES.inc(permanent=str(permanent).lower())
         logger.info("job %s failed: %s", job.id, detail)
         job._transition(JobStatus.FAILED, detail)
 
     def cleanup_autosaves(self, jobs) -> None:
-        """Remove job-scoped autosave generations of terminal jobs."""
+        """Remove job-scoped autosave generations of terminal jobs.
+
+        Rotation depth follows the engine's ``autosave_keep`` (probing a
+        little past it, like io.checkpoint.find_resumable, in case keep
+        was lowered between runs) so raised keep values don't leak files.
+        Jobs drained into the journal keep their autosaves — they are the
+        restart's resume points."""
         for job in jobs:
+            if job.leave_in_journal or not job.terminal:
+                continue
             tag = job.id
             base = os.path.join(job.base_dir, f"sirius_autosave.{tag}.h5")
-            for p in [base] + [f"{base}.{i}" for i in range(1, 10)]:
+            paths = [base] + [
+                f"{base}.{i}" for i in range(1, max(self.autosave_keep, 1) + 1)
+            ]
+            i = max(self.autosave_keep, 1) + 1
+            while os.path.exists(f"{base}.{i}") and i < 100:
+                paths.append(f"{base}.{i}")
+                i += 1
+            for p in paths:
                 if os.path.exists(p):
                     try:
                         os.remove(p)

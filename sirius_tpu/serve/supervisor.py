@@ -1,0 +1,229 @@
+"""Slice supervision: heartbeats, a watchdog, worker respawn, and poison
+quarantine for the serving scheduler.
+
+The scheduler's thread-per-slice workers are supervised rather than
+trusted: each worker heartbeats every queue-poll cycle and registers the
+job it is about to run; a watchdog thread checks the fleet every
+``interval`` seconds and recovers from the two ways a slice dies in
+production:
+
+- **Worker death** (a crash escaping the job sandbox — driven in tests
+  by the ``serve.worker_crash`` fault): the thread is gone but its job
+  never reached a terminal state. The watchdog strikes the job, hands it
+  back to the queue (or quarantines it), and respawns a replacement
+  worker on the same device slice.
+- **Worker hang** (a job stuck inside run_scf past its wall-time budget
+  — driven by ``serve.job_hang``): Python threads cannot be killed, so
+  the watchdog *abandons* the job instead: it bumps ``job._epoch`` (the
+  hung worker notices and discards any late result), strikes the job,
+  and spawns a replacement worker so the slice keeps serving. The hung
+  thread unwinds on its own or stays parked; either way it can no longer
+  touch the job.
+
+**Poison quarantine**: a job that kills or stalls its workers
+``poison_threshold`` times is permanently failed (``job.quarantined``)
+instead of being retried into a fourth dead slice — the serving-layer
+analog of a poison-pill message queue. Strikes are tracked separately
+from ``job.attempts`` so an honest preemption retry is never conflated
+with evidence of a hostile deck.
+
+Everything the supervisor does is observable: ``serve_watchdog_fires_total``
+(kind=crash|hang), ``serve_worker_restarts_total`` (reason),
+``serve_quarantines_total``, plus ``watchdog_fire`` / ``worker_restart``
+/ ``quarantine`` JSONL events.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger
+
+logger = get_logger("serve")
+
+_WATCHDOG_FIRES = obs_metrics.REGISTRY.counter(
+    "serve_watchdog_fires_total", "watchdog detections by kind")
+_RESTARTS = obs_metrics.REGISTRY.counter(
+    "serve_worker_restarts_total", "slice workers respawned by reason")
+_QUARANTINES = obs_metrics.REGISTRY.counter(
+    "serve_quarantines_total", "jobs quarantined as poison")
+
+
+class WorkerState:
+    """Mutable supervision record for one slice worker."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.thread: threading.Thread | None = None
+        self.heartbeat = time.time()
+        self.generation = 0  # how many threads have served this slice
+        self.job = None  # Job currently assigned (None while idle)
+        self.job_epoch = 0
+        self.job_started = 0.0
+
+
+class SliceSupervisor:
+    """Watchdog over the scheduler's slice workers.
+
+    ``scheduler`` must provide ``queue``, ``slices``, ``_worker(idx,
+    devs)``, and the recovery entry points ``_watchdog_retry(job,
+    detail, failure_class)`` / ``_fail(job, detail, permanent,
+    quarantined)``.
+    """
+
+    def __init__(self, scheduler, *, poison_threshold: int = 2,
+                 job_wall_time_budget: float | None = None,
+                 interval: float = 0.25,
+                 heartbeat_timeout: float = 30.0):
+        self.scheduler = scheduler
+        self.poison_threshold = max(1, int(poison_threshold))
+        self.job_wall_time_budget = job_wall_time_budget
+        self.interval = float(interval)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.workers = [
+            WorkerState(i) for i in range(len(scheduler.slices))
+        ]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watchdog: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        for state in self.workers:
+            self._spawn_locked(state, reason="start")
+        self._watchdog = threading.Thread(
+            target=self._watch, name="serve-watchdog", daemon=True)
+        self._watchdog.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+
+    def join(self, timeout: float | None = None) -> None:
+        for state in self.workers:
+            t = state.thread
+            if t is not None and t.is_alive():
+                t.join(timeout)
+
+    def _spawn_locked(self, state: WorkerState, reason: str) -> None:
+        state.generation += 1
+        state.heartbeat = time.time()
+        name = f"serve-slice-{state.idx}"
+        if state.generation > 1:
+            name += f"-g{state.generation}"
+            _RESTARTS.inc(reason=reason)
+            obs_events.emit("worker_restart", slice=state.idx,
+                            generation=state.generation, reason=reason)
+            logger.warning("respawning slice %d worker (%s, generation %d)",
+                           state.idx, reason, state.generation)
+        t = threading.Thread(
+            target=self.scheduler._worker,
+            args=(state.idx, self.scheduler.slices[state.idx]),
+            name=name, daemon=True,
+        )
+        state.thread = t
+        t.start()
+
+    # -- worker-side notifications ----------------------------------------
+
+    def beat(self, idx: int) -> None:
+        self.workers[idx].heartbeat = time.time()
+
+    def note_job(self, idx: int, job, epoch: int) -> None:
+        state = self.workers[idx]
+        with self._lock:
+            state.job = job
+            state.job_epoch = epoch
+            state.job_started = time.time()
+        state.heartbeat = state.job_started
+
+    def note_idle(self, idx: int, job) -> None:
+        state = self.workers[idx]
+        with self._lock:
+            if state.job is job:
+                state.job = None
+        state.heartbeat = time.time()
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _queue_active(self) -> bool:
+        q = self.scheduler.queue
+        return not (q.closed and len(q) == 0)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.interval):
+            for state in self.workers:
+                try:
+                    self._check_worker(state)
+                except Exception:
+                    logger.exception("watchdog check failed for slice %d",
+                                     state.idx)
+
+    def _check_worker(self, state: WorkerState) -> None:
+        thread = state.thread
+        if thread is not None and not thread.is_alive():
+            with self._lock:
+                job, epoch = state.job, state.job_epoch
+                state.job = None
+            if job is not None and not job.terminal and job._epoch == epoch:
+                _WATCHDOG_FIRES.inc(kind="crash")
+                obs_events.emit("watchdog_fire", reason="crash",
+                                slice=state.idx, job_id=job.id)
+                logger.error("slice %d worker died running job %s",
+                             state.idx, job.id)
+                self._strike(job, f"worker crash on slice {state.idx}",
+                             failure_class="crash")
+            if self._queue_active() and not self._stop.is_set():
+                with self._lock:
+                    self._spawn_locked(state, reason="crash")
+            return
+        with self._lock:
+            job, epoch, started = (
+                state.job, state.job_epoch, state.job_started)
+        if job is None or job.terminal:
+            return
+        budget = job.wall_time_budget or self.job_wall_time_budget
+        if not budget:
+            return
+        elapsed = time.time() - started
+        if elapsed <= budget:
+            return
+        # hung: abandon the job (the worker thread cannot be killed),
+        # strike it, and replace the worker so the slice keeps serving
+        _WATCHDOG_FIRES.inc(kind="hang")
+        obs_events.emit("watchdog_fire", reason="hang", slice=state.idx,
+                        job_id=job.id, elapsed_s=elapsed, budget_s=budget)
+        logger.error("slice %d worker hung on job %s (%.1fs > budget %.1fs)",
+                     state.idx, job.id, elapsed, budget)
+        with self._lock:
+            if state.job is not job or job._epoch != epoch:
+                return  # finished or already handled in the window
+            job._epoch += 1  # the hung worker's result is now stale
+            state.job = None
+        self._strike(job, f"hung {elapsed:.1f}s (budget {budget:.1f}s) "
+                          f"on slice {state.idx}", failure_class="hang")
+        if self._queue_active() and not self._stop.is_set():
+            with self._lock:
+                self._spawn_locked(state, reason="hang")
+
+    def _strike(self, job, detail: str, failure_class: str) -> None:
+        job.poison_strikes += 1
+        if job.poison_strikes >= self.poison_threshold:
+            _QUARANTINES.inc()
+            obs_events.emit("quarantine", job_id=job.id,
+                            strikes=job.poison_strikes, detail=detail)
+            logger.error("quarantining job %s after %d strikes: %s",
+                         job.id, job.poison_strikes, detail)
+            self.scheduler._fail(
+                job,
+                f"quarantined after {job.poison_strikes} worker-fatal "
+                f"strikes: {detail}",
+                permanent=True, quarantined=True,
+            )
+        else:
+            self.scheduler._watchdog_retry(job, detail, failure_class)

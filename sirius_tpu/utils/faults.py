@@ -17,21 +17,37 @@ Sites currently wired:
   md.autosave_kill   die right after an MD trajectory checkpoint (md/driver)
   checkpoint.before_rename  die inside save_state between the temp-file
                             write and the atomic rename
+  serve.worker_crash kill a serve slice-worker thread mid-job (WorkerCrash
+                     escapes the scheduler's catch-all; the supervisor
+                     watchdog must respawn the slice) — ``iteration`` is
+                     the job attempt index (0-based)
+  serve.job_hang     make a serve job attempt hang on its worker instead
+                     of running, until the watchdog abandons it —
+                     ``iteration`` is the job attempt index
+  serve.journal_torn tear the next job-journal append mid-line (partial
+                     write, no newline, no fsync — the on-disk state a
+                     crash inside write() leaves) — ``iteration`` is the
+                     journal's append sequence number
 
 Plans are process-local (``install``/``clear``) or inherited by child
-processes through the ``SIRIUS_TPU_FAULTS`` environment variable, e.g.::
+processes through the ``SIRIUS_TPU_FAULTS`` environment variable. The env
+grammar is ``site@iter:action*count`` per comma-separated entry — ``@iter``
+defaults to 0, ``:action`` to ``nan``, ``*count`` to 1 — e.g.::
 
     SIRIUS_TPU_FAULTS="scf.density@3:nan,scf.autosave_kill@5:exit"
+    SIRIUS_TPU_FAULTS="serve.job_hang@0:flag*2"   # hang attempts 1 and 2
 
 Each armed entry fires ``count`` times (default once) and then disarms, so
 an injected NaN does not re-poison the state the supervisor just rolled
-back.
+back. ``count`` must be >= 0 (0 arms a spec that never fires; negative
+counts are rejected at validation).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 
 import numpy as np
 
@@ -40,6 +56,15 @@ ACTIONS = ("nan", "raise", "exit", "flag")
 
 class SimulatedKill(Exception):
     """In-process stand-in for SIGKILL/preemption (raised by 'raise' faults)."""
+
+
+class WorkerCrash(BaseException):
+    """Kills a serving worker thread (serve.worker_crash site).
+
+    Deliberately a BaseException: the slice scheduler's catch-all
+    ``except Exception`` must NOT swallow it — the point of the site is a
+    worker thread dying with a job still assigned, which only the
+    supervisor watchdog can recover from."""
 
 
 @dataclasses.dataclass
@@ -54,10 +79,18 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault action '{self.action}' (known: {ACTIONS})"
             )
+        if self.count < 0:
+            raise ValueError(
+                f"fault count must be >= 0, got {self.count} "
+                f"(site '{self.site}')"
+            )
 
 
 _plan: list[FaultSpec] = []
 _log: list[tuple[str, int, str]] = []  # (site, iteration, action) fired
+# serve slice-workers probe sites concurrently: match-and-consume must be
+# atomic or a count-1 spec can fire on two threads at once
+_mu = threading.Lock()
 
 
 def install(specs) -> None:
@@ -86,13 +119,17 @@ def fired() -> list[tuple[str, int, str]]:
 
 
 def load_env(env: str | None = None) -> None:
-    """Parse SIRIUS_TPU_FAULTS ('site@iter:action[,...]') into the plan."""
+    """Parse SIRIUS_TPU_FAULTS ('site@iter:action*count[,...]') into the
+    plan. ``@iter`` defaults to 0, ``:action`` to 'nan', ``*count`` to 1."""
     env = env if env is not None else os.environ.get("SIRIUS_TPU_FAULTS", "")
     specs = []
     for tok in filter(None, (t.strip() for t in env.split(","))):
-        site, _, rest = tok.partition("@")
-        itspec, _, action = rest.partition(":")
-        specs.append(FaultSpec(site, int(itspec or 0), action or "nan"))
+        # action first, then iteration: 'site:action' (no @iter) is legal
+        head, _, action = tok.partition(":")
+        site, _, itspec = head.partition("@")
+        action, _, countspec = action.partition("*")
+        specs.append(FaultSpec(site, int(itspec or 0), action or "nan",
+                               int(countspec or 1)))
     install(specs)
 
 
@@ -109,25 +146,29 @@ def _consume(spec: FaultSpec, iteration: int) -> str:
     return spec.action
 
 
+def _take(site: str, iteration: int) -> str | None:
+    """Atomically match-and-consume one shot; None when nothing armed."""
+    with _mu:
+        spec = _match(site, iteration)
+        if spec is None:
+            return None
+        return _consume(spec, iteration)
+
+
 def armed(site: str, iteration: int = 0) -> bool:
     """True (and consumes one shot) when a 'flag' fault is armed here.
     Used for sites that alter control flow rather than data, e.g.
     scf.band_stagnate forcing the band-health check to fail."""
-    spec = _match(site, iteration)
-    if spec is None:
-        return False
-    _consume(spec, iteration)
-    return True
+    return _take(site, iteration) is not None
 
 
 def check(site: str, iteration: int = 0) -> None:
     """Fire a kill-style fault: 'raise' -> SimulatedKill, 'exit' -> hard
     process exit with no cleanup (the closest in-process analog of
     SIGKILL/preemption)."""
-    spec = _match(site, iteration)
-    if spec is None:
+    action = _take(site, iteration)
+    if action is None:
         return
-    action = _consume(spec, iteration)
     if action == "raise":
         raise SimulatedKill(f"fault '{site}' at iteration {iteration}")
     if action == "exit":
@@ -139,10 +180,9 @@ def corrupt(site: str, iteration: int, arr):
     """Return `arr` with a NaN injected in its first element when a 'nan'
     fault is armed at (site, iteration); otherwise `arr` unchanged. Works
     for numpy arrays and jax arrays (functional .at update)."""
-    spec = _match(site, iteration)
-    if spec is None:
+    action = _take(site, iteration)
+    if action is None:
         return arr
-    action = _consume(spec, iteration)
     if action != "nan":
         if action == "raise":
             raise SimulatedKill(f"fault '{site}' at iteration {iteration}")

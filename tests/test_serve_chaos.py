@@ -1,0 +1,436 @@
+"""Fault-tolerant serving (ISSUE 8): durable job journal + replay, slice
+supervision (watchdog, respawn, poison quarantine), retry backoff at the
+queue, bounded admission, graceful drain — plus regression tests for the
+satellite fixes (retry metric cardinality, close/worker-exit race,
+fault-grammar counts, autosave cleanup depth)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sirius_tpu.serve import journal as journal_mod
+from sirius_tpu.serve.engine import ServeEngine
+from sirius_tpu.serve.journal import JobJournal
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus, QueueFullError
+from sirius_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(REPO, "tools", "chaos_serve.py")
+
+
+def _mkjob(job_id="j", **kw):
+    return Job({}, job_id=job_id, **kw)
+
+
+# ------------------------------------------------------------- journal unit
+
+
+def test_journal_roundtrip_replays_only_non_terminal(tmp_path):
+    jp = str(tmp_path / "jobs.journal")
+    j = JobJournal(jp)
+    a = _mkjob("a", base_dir=str(tmp_path), priority=3)
+    b = _mkjob("b")
+    for job in (a, b):
+        job.submitted_at = time.time()
+        j.record_submit(job)
+    b.status = JobStatus.DONE
+    b.finished_at = time.time()
+    j.record_terminal(b)
+    j.close()
+    pending, stats = journal_mod.replay(jp)
+    assert [r["job_id"] for r in pending] == ["a"]
+    assert pending[0]["priority"] == 3
+    assert pending[0]["base_dir"] == str(tmp_path)
+    assert stats == {"submits": 2, "terminals": 1, "torn_lines": 0}
+
+
+def test_journal_replay_missing_file_is_empty(tmp_path):
+    pending, stats = journal_mod.replay(str(tmp_path / "nope.journal"))
+    assert pending == [] and stats["submits"] == 0
+
+
+@pytest.mark.faults
+def test_journal_torn_tail_is_skipped_and_repaired(tmp_path):
+    """A torn terminal record (the on-disk state a crash inside write()
+    leaves) must count as 'job never finished'; reopening must isolate
+    the torn fragment so later appends stay parseable."""
+    jp = str(tmp_path / "jobs.journal")
+    faults.install([("serve.journal_torn", 2, "flag")])  # 3rd append torn
+    j = JobJournal(jp)
+    a, b = _mkjob("a"), _mkjob("b")
+    for job in (a, b):
+        job.submitted_at = time.time()
+        j.record_submit(job)
+    a.status = JobStatus.DONE
+    a.finished_at = time.time()
+    j.record_terminal(a)  # torn: half the line, no newline
+    j.close()
+    raw = open(jp, "rb").read()
+    assert not raw.endswith(b"\n")
+    pending, stats = journal_mod.replay(jp)
+    assert {r["job_id"] for r in pending} == {"a", "b"}
+    assert stats["torn_lines"] == 1
+
+    # reopen repairs the tail; a fresh append parses cleanly after it
+    j2 = JobJournal(jp)
+    b.status = JobStatus.FAILED
+    b.finished_at = time.time()
+    j2.record_terminal(b)
+    j2.close()
+    pending, stats = journal_mod.replay(jp)
+    assert [r["job_id"] for r in pending] == ["a"]
+    assert stats["torn_lines"] == 1 and stats["terminals"] == 1
+
+
+# --------------------------------------------------- queue: admission bound
+
+
+def test_bounded_queue_rejects_when_full():
+    q = JobQueue(maxsize=1)
+    q.submit(_mkjob("a"))
+    with pytest.raises(QueueFullError):
+        q.submit(_mkjob("b"))
+    t0 = time.time()
+    with pytest.raises(QueueFullError):
+        q.submit(_mkjob("c"), block=True, timeout=0.15)
+    assert time.time() - t0 >= 0.1  # actually waited for space
+
+
+def test_requeue_bypasses_admission_bound():
+    q = JobQueue(maxsize=1)
+    q.submit(_mkjob("a"))
+    retry = _mkjob("r")
+    retry._transition(JobStatus.QUEUED)
+    q.requeue(retry, "retry")  # accepted work is never rejected
+    assert len(q) == 2
+
+
+def test_submit_blocked_until_pop_frees_space():
+    import threading
+
+    q = JobQueue(maxsize=1)
+    q.submit(_mkjob("a"))
+    threading.Timer(0.1, lambda: q.pop(timeout=0)).start()
+    q.submit(_mkjob("b"), block=True, timeout=5.0)
+    assert len(q) == 1
+
+
+# -------------------------------------------- queue: backoff bar honored
+
+
+def test_pop_honors_not_before_backoff_bar():
+    q = JobQueue()
+    j = _mkjob("b")
+    q.submit(j)
+    j.not_before = time.time() + 0.4
+    assert q.pop(timeout=0.1) is None  # backing off: not runnable yet
+    t0 = time.time()
+    assert q.pop(timeout=5.0) is j  # wakes exactly when the bar expires
+    assert 0.2 <= time.time() - t0 < 2.0
+    # a backing-off job must not starve a runnable one behind it
+    early, late = _mkjob("early"), _mkjob("late")
+    q.submit(late)
+    late.not_before = time.time() + 30.0
+    q.submit(early)
+    assert q.pop(timeout=1.0) is early
+
+
+# ------------------------------------- queue: close semantics + race fix
+
+
+def test_closed_property_and_submit_after_close():
+    q = JobQueue()
+    assert not q.closed
+    q.close()
+    assert q.closed
+    with pytest.raises(RuntimeError):
+        q.submit(_mkjob("x"))
+
+
+def test_requeue_after_close_aborts_terminally():
+    q = JobQueue()
+    j = _mkjob("r")
+    j._transition(JobStatus.QUEUED)
+    q.close()
+    q.requeue(j, "retry")
+    assert j.status == JobStatus.ABORTED and j.wait(0)
+
+
+def test_close_race_cannot_strand_queued_jobs():
+    """Regression: a job submitted just before close(), with every worker
+    already exiting, used to stay QUEUED forever (wait_all blocked). The
+    post-join abort_pending safety net must terminate it."""
+    q = JobQueue()
+    j = q.submit(_mkjob("stranded"))
+    q.close()  # workers exit without popping
+    out = q.abort_pending("queue closed before worker pickup")
+    assert [x.id for x in out] == ["stranded"]
+    assert j.status == JobStatus.ABORTED and j.wait(0)
+    assert q.pop(timeout=0) is None
+
+
+def test_terminal_transitions_are_final():
+    j = _mkjob("f")
+    j._transition(JobStatus.DONE, "converged")
+    j._transition(JobStatus.FAILED, "late hung-worker result")
+    assert j.status == JobStatus.DONE
+    assert [s for _, s, _ in j.events] == [JobStatus.DONE]
+
+
+def test_abort_pending_marks_leave_in_journal():
+    q = JobQueue()
+    a, b = q.submit(_mkjob("a")), q.submit(_mkjob("b"))
+    out = q.abort_pending("drained for restart", leave_in_journal=True)
+    assert {x.id for x in out} == {"a", "b"}
+    assert a.leave_in_journal and b.leave_in_journal
+    assert a.status == JobStatus.ABORTED
+
+
+# ------------------------------------------- engine: write-ahead + replay
+
+
+def test_engine_replays_pending_journal_jobs(tmp_path):
+    jp = str(tmp_path / "jobs.journal")
+    j = JobJournal(jp)
+    pend = _mkjob("r-1", base_dir=str(tmp_path), priority=2)
+    done = _mkjob("r-2")
+    for job in (pend, done):
+        job.submitted_at = time.time()
+        j.record_submit(job)
+    done.status = JobStatus.DONE
+    done.finished_at = time.time()
+    j.record_terminal(done)
+    j.close()
+
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path), journal_path=jp)
+    assert [x.id for x in eng.replayed] == ["r-1"]
+    assert eng.replayed[0].priority == 2
+    assert len(eng.queue) == 1
+    # drain shutdown (workers never started): the job stays non-terminal
+    # on disk, terminal in-process so wait_all() returns
+    eng.shutdown(wait=True, mode="drain")
+    assert eng.replayed[0].status == JobStatus.ABORTED
+    assert eng.replayed[0].leave_in_journal
+    assert eng.stats()["num_drained"] == 1
+    pending, _ = journal_mod.replay(jp)
+    assert [r["job_id"] for r in pending] == ["r-1"]
+
+    # an abort shutdown on the next engine settles it in the journal too
+    eng2 = ServeEngine(num_slices=1, workdir=str(tmp_path), journal_path=jp)
+    assert [x.id for x in eng2.replayed] == ["r-1"]
+    eng2.shutdown(wait=True, mode="abort")
+    pending, _ = journal_mod.replay(jp)
+    assert pending == []
+
+
+def test_engine_submit_is_write_ahead_and_rejection_is_terminal(tmp_path):
+    jp = str(tmp_path / "jobs.journal")
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path), journal_path=jp,
+                      queue_maxsize=1)
+    a = eng.submit({}, job_id="a")
+    with pytest.raises(QueueFullError):
+        eng.submit({}, job_id="b")
+    b = [j for j in eng._submitted if j.id == "b"]
+    assert not b  # rejected submissions are not tracked as accepted work
+    pending, stats = journal_mod.replay(jp)
+    # write-ahead: both submits hit the journal before admission; the
+    # rejection was recorded terminally so only 'a' replays
+    assert stats["submits"] == 2 and stats["terminals"] == 1
+    assert [r["job_id"] for r in pending] == ["a"]
+    assert a.status == JobStatus.QUEUED
+    eng.shutdown(wait=True, mode="abort")
+
+
+def test_shutdown_mode_is_validated(tmp_path):
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path))
+    with pytest.raises(ValueError):
+        eng.shutdown(mode="explode")
+    eng.shutdown(mode="abort")
+
+
+# ------------------------------------- supervisor: watchdog + quarantine
+
+
+@pytest.mark.faults
+def test_watchdog_quarantines_hanging_job_and_slice_survives(tmp_path):
+    """A job that wedges its worker twice is quarantined as poison; the
+    respawned worker keeps the slice serving other jobs."""
+    faults.install([("serve.job_hang", 0, "flag"),
+                    ("serve.job_hang", 1, "flag")])
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path),
+                      job_wall_time_budget=0.3, poison_threshold=2,
+                      watchdog_interval=0.05, backoff_base=0.01)
+    eng.start()
+    try:
+        poison = eng.submit({}, job_id="poison")
+        assert poison.wait(timeout=30.0), "watchdog never quarantined"
+        assert poison.status == JobStatus.FAILED
+        assert poison.quarantined and poison.poison_strikes == 2
+        assert poison.attempts == 2
+        assert "quarantined" in poison.error
+        # the slice survived: a follow-up job is still served (a bad deck
+        # fails fast, terminally — but it ran); generous budget so a real
+        # attempt is never mistaken for a hang
+        follow = eng.submit({}, job_id="follow", wall_time_budget=60.0)
+        assert follow.wait(timeout=30.0), "slice did not survive the hangs"
+        assert follow.attempts == 1
+        gen = eng.scheduler.supervisor.workers[0].generation
+        assert gen >= 2  # at least one respawn happened
+    finally:
+        eng.shutdown(wait=True, mode="abort")
+
+
+@pytest.mark.faults
+def test_watchdog_respawns_worker_after_crash_and_retries_job(tmp_path):
+    """A WorkerCrash kills the slice thread mid-job; the watchdog strikes
+    the job (below the quarantine threshold), requeues it with backoff,
+    and respawns the worker — the retry then settles the job."""
+    faults.install([("serve.worker_crash", 0, "flag")])
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path),
+                      poison_threshold=2, watchdog_interval=0.05,
+                      backoff_base=0.01)
+    eng.start()
+    try:
+        j = eng.submit({}, job_id="crashy", wall_time_budget=60.0)
+        assert j.wait(timeout=30.0), "crashed job never settled"
+        # attempt 1 died with the worker; attempt 2 ran the (bad) deck to
+        # a terminal verdict on the respawned worker
+        assert j.attempts == 2
+        assert j.poison_strikes == 1
+        assert not j.quarantined
+        assert j.status == JobStatus.FAILED and "bad deck" in j.error
+        assert eng.scheduler.supervisor.workers[0].generation >= 2
+    finally:
+        eng.shutdown(wait=True, mode="abort")
+    # regression: retry metric is labeled by failure class, never job id
+    # (per-job series are unbounded cardinality under real traffic)
+    from sirius_tpu.obs.metrics import REGISTRY
+
+    fam = REGISTRY.snapshot().get("serve_job_retries_total", {})
+    samples = fam.get("samples", [])
+    assert samples, "the crash retry never incremented the counter"
+    for s in samples:
+        assert set(s.get("labels", {})) == {"failure_class"}
+
+
+def test_backoff_delays_grow_exponentially_and_clamp_to_deadline(tmp_path):
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path),
+                      backoff_base=0.5, backoff_max=4.0)
+    sched = eng.scheduler
+    delays = []
+    j = _mkjob("b")
+    for attempts in (1, 2, 3):
+        j.attempts = attempts
+        delays.append(sched._backoff_delay(j))
+    assert all(b > a for a, b in zip(delays, delays[1:]))
+    assert 0.5 <= delays[0] <= 0.5 * 1.1
+    assert 2.0 <= delays[2] <= 2.0 * 1.1
+    j.attempts = 20
+    assert sched._backoff_delay(j) <= 4.0 * 1.1  # capped
+    j.deadline = time.time() + 0.05
+    assert sched._backoff_delay(j) <= 0.05  # never pushed past deadline
+    eng.shutdown(mode="abort")
+
+
+# ---------------------------------------------- housekeeping regressions
+
+
+def test_cleanup_autosaves_follows_autosave_keep(tmp_path):
+    """Regression: rotation cleanup probed a hardcoded range(1, 10); with
+    autosave_keep raised past 9 the deep generations leaked."""
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path), autosave_keep=15)
+    j = _mkjob("big", base_dir=str(tmp_path))
+    j._transition(JobStatus.DONE)
+    base = tmp_path / "sirius_autosave.big.h5"
+    paths = [base] + [tmp_path / f"sirius_autosave.big.h5.{i}"
+                      for i in range(1, 13)]
+    for p in paths:
+        p.write_bytes(b"x")
+    eng.scheduler.cleanup_autosaves([j])
+    left = [p for p in paths if p.exists()]
+    assert not left, f"leaked autosave generations: {left}"
+    eng.shutdown(mode="abort")
+
+
+def test_cleanup_autosaves_spares_drained_jobs(tmp_path):
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path))
+    j = _mkjob("drained", base_dir=str(tmp_path))
+    j.leave_in_journal = True
+    j._transition(JobStatus.ABORTED, "drained for restart")
+    keep = tmp_path / "sirius_autosave.drained.h5"
+    keep.write_bytes(b"x")
+    eng.scheduler.cleanup_autosaves([j])
+    assert keep.exists(), "drained job lost its restart resume point"
+    eng.shutdown(mode="abort")
+
+
+def test_faults_env_grammar_with_counts():
+    faults.load_env("scf.density@3:raise*2, serve.job_hang:flag ,x@1")
+    plan = faults._plan
+    assert [(s.site, s.iteration, s.action, s.count) for s in plan] == [
+        ("scf.density", 3, "raise", 2),
+        ("serve.job_hang", 0, "flag", 1),
+        ("x", 1, "nan", 1),
+    ]
+    # count semantics: fires exactly `count` times, then disarms
+    assert faults.armed("serve.job_hang", 0)
+    assert not faults.armed("serve.job_hang", 0)
+    with pytest.raises(faults.SimulatedKill):
+        faults.check("scf.density", 3)
+    with pytest.raises(faults.SimulatedKill):
+        faults.check("scf.density", 3)
+    faults.check("scf.density", 3)  # exhausted: no-op
+
+
+def test_faults_negative_count_rejected():
+    with pytest.raises(ValueError):
+        faults.load_env("scf.density@1:nan*-2")
+    with pytest.raises(ValueError):
+        faults.FaultSpec("s", 0, "nan", -1)
+    faults.load_env("scf.density@1:nan*0")  # 0 = armed but never fires
+    assert not faults.armed("scf.density", 1)
+
+
+# -------------------------------------- the real thing: kill -9 + restart
+
+
+@pytest.mark.faults
+def test_kill9_mid_scf_then_journal_replay_resumes(tmp_path):
+    """End-to-end: a serving child process hard-exits (os._exit, the
+    in-process stand-in for SIGKILL/preemption) mid-SCF; a second process
+    on the same journal replays the job, resumes its autosave, and
+    finishes it."""
+    wd = str(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "SIRIUS_TPU_FAULTS"}
+
+    def child(mode, jobs, fault_spec=""):
+        cmd = [sys.executable, CHAOS, "--child", "--workdir", wd,
+               "--mode", mode, "--jobs", str(jobs), "--slices", "1",
+               "--timeout", "240"]
+        if fault_spec:
+            cmd += ["--faults", fault_spec]
+        return subprocess.run(cmd, env=env, cwd=REPO, timeout=300).returncode
+
+    rc = child("submit", 1, "scf.autosave_kill@3:exit")
+    assert rc == 137, "the child was supposed to die mid-SCF"
+    jp = os.path.join(wd, "jobs.journal")
+    pending, _ = journal_mod.replay(jp)
+    assert [r["job_id"] for r in pending] == ["c-0"]
+    assert any(f.startswith("sirius_autosave.c-0.h5")
+               for f in os.listdir(wd)), "no autosave to resume from"
+
+    assert child("resume", 0) == 0
+    pending, stats = journal_mod.replay(jp)
+    assert pending == [] and stats["terminals"] == 1
+    res = json.load(open(os.path.join(wd, "result-resume.json")))
+    (job,) = res["jobs"]
+    assert job["id"] == "c-0" and job["status"] == "done"
+    # the replay resumed the autosave rather than restarting from scratch
+    replays = [json.loads(line) for line in
+               open(os.path.join(wd, "events.jsonl"))
+               if '"journal_replay_job"' in line]
+    assert replays and replays[0]["resume"]

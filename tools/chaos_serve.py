@@ -1,0 +1,488 @@
+#!/usr/bin/env python3
+"""Chaos gauntlet for the fault-tolerant serving layer -> CHAOS_BENCH.json.
+
+Each phase runs a real ServeEngine in a child process (tier-1 synthetic-Si
+decks, host SCF path) and attacks it the way production does:
+
+  kill_restart    SIGKILL the engine mid-campaign, restart it on the same
+                  journal, and require every job to reach a terminal state
+                  with total SCF iterations <= --max-iter-ratio x the
+                  fault-free reference (autosave resume, not from-scratch).
+  crash_respawn   a worker thread dies mid-job (serve.worker_crash); the
+                  watchdog must respawn the slice and the job must finish
+                  on a later attempt.
+  hang_quarantine a job wedges its worker twice (serve.job_hang) under a
+                  wall-time budget; the watchdog must abandon it, keep the
+                  slice serving, and quarantine the job as poison while
+                  every other job completes.
+  drain_restart   SIGTERM mid-campaign: the engine finishes in-flight
+                  work, leaves the rest in the journal, exits 0; a restart
+                  completes the remainder.
+  backoff         three injected preemptions (scf.autosave_kill) on one
+                  job; the retry delays in the event stream must increase
+                  monotonically and the job must still converge.
+  torn_tail       the journal's final append is torn mid-line
+                  (serve.journal_torn); replay must repair the tail, count
+                  the torn line, and re-run the un-acknowledged job.
+
+Usage:
+    python tools/chaos_serve.py [--phases a,b,...] [--out CHAOS_BENCH.json]
+
+The child mode (--child) is also reused by tests/test_serve_chaos.py.
+Exit status 0 = every selected phase passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+TERMINAL = ("done", "failed", "aborted")
+
+
+def make_deck(seed: int = 0, device_scf: str = "off") -> dict:
+    """Tier-1 synthetic-Si deck (loadgen family), host path by default so
+    chaos runs are dominated by SCF work, not XLA compiles."""
+    d = 0.002 * (seed % 4)
+    return {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": [1, 1, 1],
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": 40,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+        "control": {"device_scf": device_scf},
+        "synthetic": {
+            "ultrasoft": True,
+            "positions": [[0.0, 0.0, 0.0],
+                          [0.25 + d, 0.25 - d, 0.25 + d]],
+        },
+    }
+
+
+# -- tolerant JSONL readers (the whole point is that files get torn) -------
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail
+    return out
+
+
+def read_json(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def count_events(path: str, kind: str) -> int:
+    return sum(1 for r in read_jsonl(path) if r.get("kind") == kind)
+
+
+def events_of(path: str, kind: str) -> list[dict]:
+    return [r for r in read_jsonl(path) if r.get("kind") == kind]
+
+
+def journal_state(path: str) -> dict:
+    """Summarize a job journal: submitted ids, terminal ids, pending ids."""
+    submitted, terminal = [], set()
+    for rec in read_jsonl(path):
+        if rec.get("kind") == "submit" and rec.get("job_id"):
+            if rec["job_id"] not in submitted:
+                submitted.append(rec["job_id"])
+        elif rec.get("kind") == "terminal" and rec.get("status") in TERMINAL:
+            terminal.add(rec["job_id"])
+    return {
+        "submitted": submitted,
+        "terminal": sorted(terminal),
+        "pending": [j for j in submitted if j not in terminal],
+    }
+
+
+# -- child: one engine life ------------------------------------------------
+
+def child_main(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={max(args.slices, 1)}"
+        ).strip()
+
+    import threading
+
+    from sirius_tpu.serve.engine import ServeEngine
+    from sirius_tpu.utils import faults
+
+    if args.faults:
+        # in-process install (NOT the env var: run_scf re-arms the plan
+        # from SIRIUS_TPU_FAULTS on every call, which would reset counts)
+        faults.load_env(args.faults)
+
+    wd = args.workdir
+    eng = ServeEngine(
+        num_slices=args.slices, workdir=wd,
+        autosave_every=1, autosave_keep=2,
+        events_path=os.path.join(wd, "events.jsonl"),
+        journal_path=os.path.join(wd, "jobs.journal"),
+        job_wall_time_budget=None if args.budget_first else args.budget,
+        poison_threshold=args.poison,
+        watchdog_interval=0.1,
+        backoff_base=args.backoff_base, backoff_max=10.0,
+    )
+    drain = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        print("chaos child: SIGTERM — draining", file=sys.stderr)
+        drain.set()
+        eng.queue.close()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    eng.start()
+    if args.mode == "submit":
+        for i in range(args.jobs):
+            # --budget-first scopes the wall-time budget to job 0 (the
+            # designated poison job); a budget tight enough to catch an
+            # injected hang quickly would false-positive on a real cold run
+            budget = args.budget if (i == 0 or not args.budget_first) \
+                else None
+            eng.submit(make_deck(i), job_id=f"c-{i}",
+                       max_retries=args.max_retries,
+                       wall_time_budget=budget)
+    # resume mode submits nothing: the journal replay IS the workload
+    bar = time.time() + args.timeout
+    ok = False
+    while not drain.is_set():
+        ok = eng.wait_all(timeout=0.5)
+        if ok or time.time() > bar:
+            break
+    eng.shutdown(wait=True, mode="drain")
+    result = {
+        "mode": args.mode,
+        "drained": drain.is_set(),
+        "stats": eng.stats(),
+        "jobs": [j.to_dict() for j in eng._submitted],
+        "faults_fired": faults.fired(),
+    }
+    with open(os.path.join(wd, f"result-{args.mode}.json"), "w") as f:
+        json.dump(result, f, indent=2, default=float)
+    all_terminal = all(j.terminal for j in eng._submitted)
+    return 0 if (all_terminal or drain.is_set()) else 3
+
+
+# -- parent: the gauntlet --------------------------------------------------
+
+def spawn_child(wd: str, mode: str, jobs: int, slices: int,
+                faults: str = "", budget: float | None = None,
+                budget_first: bool = False,
+                poison: int = 2, max_retries: int = 2,
+                backoff_base: float = 0.05,
+                timeout: float = 300.0) -> subprocess.Popen:
+    os.makedirs(wd, exist_ok=True)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--workdir", wd, "--mode", mode, "--jobs", str(jobs),
+           "--slices", str(slices), "--max-retries", str(max_retries),
+           "--poison", str(poison), "--backoff-base", str(backoff_base),
+           "--timeout", str(timeout)]
+    if faults:
+        cmd += ["--faults", faults]
+    if budget is not None:
+        cmd += ["--budget", str(budget)]
+    if budget_first:
+        cmd += ["--budget-first"]
+    env = dict(os.environ)
+    env.pop("SIRIUS_TPU_FAULTS", None)  # serve faults go in-process only
+    return subprocess.Popen(cmd, env=env, cwd=REPO)
+
+
+def run_child(wd, mode, jobs, slices, deadline=300.0, **kw) -> int:
+    proc = spawn_child(wd, mode, jobs, slices, timeout=deadline, **kw)
+    try:
+        return proc.wait(timeout=deadline + 60.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return -9
+
+
+def wait_for(pred, timeout: float, interval: float = 0.2) -> bool:
+    bar = time.time() + timeout
+    while time.time() < bar:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def phase_kill_restart(root: str, jobs: int, slices: int,
+                       max_ratio: float) -> dict:
+    """SIGKILL mid-campaign; restart on the same journal; all jobs must
+    finish with total SCF iterations <= max_ratio x a fault-free run."""
+    ref_wd = os.path.join(root, "kill_ref")
+    rc_ref = run_child(ref_wd, "submit", jobs, slices)
+    ref_iters = count_events(os.path.join(ref_wd, "events.jsonl"),
+                             "scf_iteration")
+    ref = journal_state(os.path.join(ref_wd, "jobs.journal"))
+
+    wd = os.path.join(root, "kill_chaos")
+    os.makedirs(wd, exist_ok=True)
+    events = os.path.join(wd, "events.jsonl")
+    proc = spawn_child(wd, "submit", jobs, slices)
+    # kill once the campaign is genuinely mid-flight: some SCF progress
+    # made AND at least one autosave on disk to resume from
+    armed = wait_for(
+        lambda: (count_events(events, "scf_iteration") >=
+                 max(4, ref_iters // 3)
+                 and glob.glob(os.path.join(wd, "sirius_autosave.*.h5*"))),
+        timeout=180.0)
+    proc.send_signal(signal.SIGKILL)
+    rc_kill = proc.wait()
+    mid = journal_state(os.path.join(wd, "jobs.journal"))
+
+    rc_restart = run_child(wd, "resume", 0, slices)
+    final = journal_state(os.path.join(wd, "jobs.journal"))
+    total_iters = count_events(events, "scf_iteration")
+    ratio = (total_iters / ref_iters) if ref_iters else float("inf")
+    replays = count_events(events, "journal_replay_job")
+    ok = (rc_ref == 0 and armed and rc_kill == -signal.SIGKILL
+          and rc_restart == 0 and len(final["submitted"]) == jobs
+          and not final["pending"] and replays == len(mid["pending"]) > 0
+          and ratio <= max_ratio)
+    return {
+        "ok": ok, "rc_ref": rc_ref, "rc_kill": rc_kill,
+        "rc_restart": rc_restart, "ref_scf_iterations": ref_iters,
+        "total_scf_iterations": total_iters, "iter_ratio": ratio,
+        "max_iter_ratio": max_ratio, "jobs": jobs,
+        "pending_at_kill": len(mid["pending"]), "replayed": replays,
+        "pending_after_restart": len(final["pending"]),
+        "ref_pending": len(ref["pending"]),
+    }
+
+
+def phase_crash_respawn(root: str) -> dict:
+    """A worker thread dies mid-job; the watchdog respawns the slice and
+    the job completes on its second attempt."""
+    wd = os.path.join(root, "crash")
+    rc = run_child(wd, "submit", jobs=2, slices=1,
+                   faults="serve.worker_crash@0:flag")
+    events = os.path.join(wd, "events.jsonl")
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    jobs = {j["id"]: j for j in res.get("jobs", [])}
+    crashed = jobs.get("c-0", {})
+    restarts = count_events(events, "worker_restart")
+    fires = [e for e in events_of(events, "watchdog_fire")
+             if e.get("reason") == "crash"]
+    ok = (rc == 0 and crashed.get("status") == "done"
+          and crashed.get("attempts", 0) >= 2 and restarts >= 1
+          and len(fires) >= 1
+          and all(j["status"] == "done" for j in jobs.values()))
+    return {"ok": ok, "rc": rc, "worker_restarts": restarts,
+            "watchdog_crash_fires": len(fires),
+            "crashed_job_attempts": crashed.get("attempts"),
+            "statuses": {k: v.get("status") for k, v in jobs.items()}}
+
+
+def phase_hang_quarantine(root: str) -> dict:
+    """One job hangs its worker twice under a wall-time budget: the
+    watchdog abandons it both times, the slice keeps serving the other
+    jobs, and the job is quarantined as poison."""
+    # 1 slice so the single worker deterministically pops c-0 first (the
+    # fault hits its attempts 1 and 2); --budget-first so real cold runs
+    # of the other jobs are not mistaken for hangs
+    wd = os.path.join(root, "hang")
+    rc = run_child(wd, "submit", jobs=3, slices=1,
+                   faults="serve.job_hang@0:flag,serve.job_hang@1:flag",
+                   budget=2.0, budget_first=True, poison=2)
+    events = os.path.join(wd, "events.jsonl")
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    jobs = res.get("jobs", [])
+    quarantined = [j for j in jobs if j.get("quarantined")]
+    done = [j for j in jobs if j["status"] == "done"]
+    hangs = [e for e in events_of(events, "watchdog_fire")
+             if e.get("reason") == "hang"]
+    ok = (rc == 0 and len(jobs) == 3
+          and [j["id"] for j in quarantined] == ["c-0"]
+          and len(done) == 2 and len(hangs) >= 2
+          and count_events(events, "quarantine") >= 1
+          and count_events(events, "worker_restart") >= 1)
+    return {"ok": ok, "rc": rc, "hang_fires": len(hangs),
+            "quarantined": [j["id"] for j in quarantined],
+            "done": len(done),
+            "worker_restarts": count_events(events, "worker_restart")}
+
+
+def phase_drain_restart(root: str, jobs: int = 5) -> dict:
+    """SIGTERM mid-campaign drains gracefully (exit 0, remainder left in
+    the journal); a restart on the same journal completes it."""
+    wd = os.path.join(root, "drain")
+    os.makedirs(wd, exist_ok=True)
+    jp = os.path.join(wd, "jobs.journal")
+    proc = spawn_child(wd, "submit", jobs, slices=1)
+    armed = wait_for(lambda: len(journal_state(jp)["terminal"]) >= 1,
+                     timeout=180.0)
+    proc.send_signal(signal.SIGTERM)
+    rc_drain = proc.wait(timeout=120.0)
+    mid = journal_state(jp)
+    rc_restart = run_child(wd, "resume", 0, 1)
+    final = journal_state(jp)
+    drains = count_events(os.path.join(wd, "events.jsonl"), "drain")
+    ok = (armed and rc_drain == 0 and len(mid["pending"]) >= 1
+          and rc_restart == 0 and not final["pending"]
+          and len(final["submitted"]) == jobs and drains >= 1)
+    return {"ok": ok, "rc_drain": rc_drain, "rc_restart": rc_restart,
+            "terminal_at_sigterm": len(mid["terminal"]),
+            "left_in_journal": len(mid["pending"]),
+            "pending_after_restart": len(final["pending"]),
+            "drain_events": drains}
+
+
+def phase_backoff(root: str) -> dict:
+    """Three injected preemptions on one job: the retry delays in the
+    event stream must increase monotonically (exponential backoff) and
+    the job must still converge via autosave resume."""
+    wd = os.path.join(root, "backoff")
+    rc = run_child(
+        wd, "submit", jobs=1, slices=1, max_retries=4, backoff_base=0.2,
+        faults=("scf.autosave_kill@2:raise,scf.autosave_kill@4:raise,"
+                "scf.autosave_kill@6:raise"))
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    job = (res.get("jobs") or [{}])[0]
+    backs = events_of(os.path.join(wd, "events.jsonl"), "backoff")
+    delays = [e["delay_s"] for e in backs]
+    monotonic = all(b > a for a, b in zip(delays, delays[1:]))
+    ok = (rc == 0 and job.get("status") == "done"
+          and len(delays) >= 2 and monotonic
+          and all(e.get("failure_class") == "preempted" for e in backs))
+    return {"ok": ok, "rc": rc, "status": job.get("status"),
+            "attempts": job.get("attempts"), "backoff_delays_s": delays,
+            "monotonic": monotonic}
+
+
+def phase_torn_tail(root: str) -> dict:
+    """The last journal append is torn mid-line: replay repairs the tail,
+    counts the torn line, and re-runs the un-acknowledged job."""
+    wd = os.path.join(root, "torn")
+    # 2 jobs, 1 slice -> 4 appends (2 submits then 2 terminals); tear the
+    # final terminal (seq 3): on disk the job never finished
+    rc1 = run_child(wd, "submit", jobs=2, slices=1,
+                    faults="serve.journal_torn@3:flag")
+    jp = os.path.join(wd, "jobs.journal")
+    mid = journal_state(jp)
+    rc2 = run_child(wd, "resume", 0, 1)
+    final = journal_state(jp)
+    replays = count_events(os.path.join(wd, "events.jsonl"),
+                           "journal_replay_job")
+    ok = (rc1 == 0 and len(mid["pending"]) == 1 and rc2 == 0
+          and not final["pending"] and replays == 1)
+    return {"ok": ok, "rc_first": rc1, "rc_restart": rc2,
+            "pending_after_tear": len(mid["pending"]),
+            "replayed": replays,
+            "pending_after_restart": len(final["pending"])}
+
+
+PHASES = ("kill_restart", "crash_respawn", "hang_quarantine",
+          "drain_restart", "backoff", "torn_tail")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--mode", choices=["submit", "resume"], default="submit")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--jobs", type=int, default=4)
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--faults", default="")
+    ap.add_argument("--budget", type=float, default=None)
+    ap.add_argument("--budget-first", action="store_true",
+                    help="apply --budget to the first job only")
+    ap.add_argument("--poison", type=int, default=2)
+    ap.add_argument("--max-retries", type=int, default=2)
+    ap.add_argument("--backoff-base", type=float, default=0.05)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--phases", default=",".join(PHASES),
+                    help="comma-separated subset of: " + ",".join(PHASES))
+    ap.add_argument("--max-iter-ratio", type=float, default=1.5,
+                    help="kill_restart budget: total SCF iterations over "
+                         "the fault-free reference")
+    ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
+    args = ap.parse_args(argv)
+
+    if args.child:
+        if not args.workdir:
+            ap.error("--child requires --workdir")
+        return child_main(args)
+
+    import tempfile
+
+    root = args.workdir or tempfile.mkdtemp(prefix="sirius_chaos_")
+    selected = [p.strip() for p in args.phases.split(",") if p.strip()]
+    unknown = [p for p in selected if p not in PHASES]
+    if unknown:
+        ap.error(f"unknown phase(s): {unknown}")
+
+    t0 = time.time()
+    results = {}
+    for name in selected:
+        print(f"=== chaos phase: {name} ===", flush=True)
+        tp = time.time()
+        if name == "kill_restart":
+            res = phase_kill_restart(root, args.jobs, args.slices,
+                                     args.max_iter_ratio)
+        elif name == "crash_respawn":
+            res = phase_crash_respawn(root)
+        elif name == "hang_quarantine":
+            res = phase_hang_quarantine(root)
+        elif name == "drain_restart":
+            res = phase_drain_restart(root)
+        elif name == "backoff":
+            res = phase_backoff(root)
+        else:
+            res = phase_torn_tail(root)
+        res["wall_s"] = time.time() - tp
+        results[name] = res
+        print(json.dumps({name: res}, indent=2, default=float), flush=True)
+
+    bench = {
+        "bench": "serve_chaos",
+        "deck": "synthetic-Si gk=3.0 pw=7.0 nb=8 (host path)",
+        "phases": results,
+        "ok": all(r["ok"] for r in results.values()),
+        "wall_s": time.time() - t0,
+        "workdir": root,
+    }
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=2, default=float)
+    print(f"wrote {args.out} (ok={bench['ok']})")
+    return 0 if bench["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
