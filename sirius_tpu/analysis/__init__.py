@@ -1,0 +1,38 @@
+"""sirius-lint: JAX-aware static analysis for the sirius_tpu tree.
+
+Three rule families keep the invariants the test suite cannot check
+mechanically:
+
+- **JAX rules** (analysis/jaxrules.py), scoped to *jit-reachable*
+  functions (the transitive closure of every ``jax.jit``/``jax.pmap``
+  seed and ``jax.lax`` higher-order body over the project call graph):
+  tracer-hostile Python control flow, ``np.*`` calls and Python-float
+  accumulation inside compiled code, implicit host syncs, donated-buffer
+  reuse, dtype-less array creation (the fp64-path drift groundwork for
+  the mixed-precision ladder), and non-hashable static arguments.
+- **Concurrency rules** (analysis/lockrules.py) for the threaded
+  ``serve/`` modules: a static lock-acquisition graph built from
+  ``with self._lock:`` nesting and called-method edges (Condition
+  aliasing resolved), cycle detection (potential deadlock), unlocked
+  shared-attribute writes reachable from two threads, and the
+  ``*_locked``-naming contract.
+- **Registry-consistency rules** (analysis/registryrules.py): every
+  ``control.*`` read must name a ``config/schema.py`` field, every
+  fault-site literal must be in ``utils/faults.KNOWN_SITES``, and every
+  ``scf.*``/``md.*`` span must have an ``obs/costs.scf_stage_costs``
+  key or an ``UNCOSTED_SPANS`` exemption.
+
+Findings are suppressed per line with ``# sirius-lint: disable=RULE``
+(or ``disable=*``), per file with ``# sirius-lint: disable-file=RULE``,
+and per tree with the checked-in ``LINT_BASELINE.json`` — CI fails only
+on *new* violations (``sirius-lint --baseline LINT_BASELINE.json``).
+"""
+
+from sirius_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintEngine,
+    ProjectIndex,
+    all_rules,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,145 @@
+"""``sirius-lint`` CLI.
+
+Typical use::
+
+    sirius-lint                                  # lint the whole tree
+    sirius-lint sirius_tpu/serve                 # one subtree
+    sirius-lint --baseline LINT_BASELINE.json    # CI mode: new findings only
+    sirius-lint --write-baseline LINT_BASELINE.json   # accept current state
+    sirius-lint --list-rules                     # rule catalog
+
+Exit codes: 0 = clean (or nothing new vs the baseline), 1 = findings,
+2 = unparseable inputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from sirius_tpu.analysis.core import (
+    DEFAULT_SCAN,
+    LintEngine,
+    all_rules,
+    collect_files,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+
+def _detect_root(root: str | None) -> str:
+    if root:
+        return os.path.abspath(root)
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, "sirius_tpu")):
+        return cwd
+    import sirius_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        sirius_tpu.__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sirius-lint",
+        description="JAX-aware static analysis for the sirius_tpu tree "
+                    "(jit purity, serve lock discipline, registry "
+                    "consistency)")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/directories to lint (default: "
+                        f"{' '.join(DEFAULT_SCAN)} under --root)")
+    p.add_argument("--root", default=None,
+                   help="repository root (default: auto-detected)")
+    p.add_argument("--baseline", default=None,
+                   help="compare against this baseline; only NEW findings "
+                        "fail the run")
+    p.add_argument("--write-baseline", default=None, metavar="PATH",
+                   help="accept the current findings as the baseline "
+                        "(preserves justifications for kept entries)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="write the full findings report as JSON (CI "
+                        "artifact)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule-name filter")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            doc = " ".join((r.__doc__ or "").split())
+            print(f"{r.name:24s} {doc}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"sirius-lint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    root = _detect_root(args.root)
+    if args.paths:
+        paths = collect_files(root, tuple(args.paths))
+    else:
+        targets = tuple(t for t in DEFAULT_SCAN
+                        if os.path.exists(os.path.join(root, t)))
+        paths = collect_files(root, targets)
+    if not paths:
+        print("sirius-lint: no python files to lint", file=sys.stderr)
+        return 2
+
+    engine = LintEngine(root, paths=paths, rules=rules)
+    findings = engine.run()
+    for err in engine.project.errors:
+        print(f"sirius-lint: parse error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        old = load_baseline(args.write_baseline)
+        agg = write_baseline(args.write_baseline, findings, old)
+        print(f"sirius-lint: baseline written to {args.write_baseline} "
+              f"({len(findings)} finding(s), {len(agg)} fingerprint(s))")
+        return 0
+
+    shown = findings
+    baseline = {}
+    if args.baseline:
+        baseline = load_baseline(args.baseline)
+        shown = new_findings(findings, baseline)
+
+    if args.report:
+        report = {
+            "root": root,
+            "files": len(paths),
+            "rules": [r.name for r in rules],
+            "findings": [f.to_dict() for f in findings],
+            "new_findings": [f.to_dict() for f in shown],
+            "baselined": len(findings) - len(shown),
+            "suppressed_inline": engine.suppressed_count,
+        }
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+
+    for f in shown:
+        print(f)
+    label = "new " if args.baseline else ""
+    summary = (f"sirius-lint: {len(shown)} {label}finding(s) in "
+               f"{len(paths)} file(s)")
+    if args.baseline:
+        summary += f" ({len(findings) - len(shown)} baselined)"
+    if engine.suppressed_count:
+        summary += f" ({engine.suppressed_count} suppressed inline)"
+    print(summary)
+    if engine.project.errors:
+        return 2
+    return 1 if shown else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

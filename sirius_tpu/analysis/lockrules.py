@@ -1,0 +1,551 @@
+"""Concurrency rules for the threaded ``serve/`` modules.
+
+A static lock-acquisition model is built from ``with self._lock:``
+nesting plus interprocedural call edges:
+
+- **Lock identity** is ``(Class, attribute)`` (or ``(module, name)`` for
+  module-level locks), with ``threading.Condition(self._lock)`` aliased
+  to the lock it wraps — JobQueue's ``_not_empty``/``_not_full`` are the
+  *same* lock as ``_lock``.
+- **Held sets** propagate through resolved calls: ``self.method()``,
+  ``self.attr.method()`` (attribute types from ``__init__`` assignments,
+  parameter annotations, and a class-name suffix heuristic),
+  parameter/local calls (``job._transition()`` via the ``job: Job``
+  annotation), and callback attributes (``job._on_terminal = self.x``).
+- **Thread roots** are ``threading.Thread(target=...)`` methods,
+  self-method callback arguments (``health_fn=self._health``), callback
+  attribute assignments, and every public method (the external caller's
+  thread).
+
+Three rules read the model: ``lock-order-cycle`` (a cycle in the
+acquisition graph, or re-acquiring a held non-reentrant Lock — both
+potential deadlocks), ``unlocked-shared-write`` (a ``self.attr`` write
+outside ``__init__`` with no lock held on some path, for an attribute
+accessed from two or more distinct roots), and ``locked-suffix-call``
+(a ``*_locked``-named method invoked with no lock held).
+
+Known limitation: ``Condition.wait()`` releasing the lock inside a
+``with`` block is not modelled; held sets are an over-approximation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sirius_tpu.analysis.core import (
+    FunctionInfo,
+    ProjectIndex,
+    call_name,
+    dotted_name,
+)
+
+SCOPE_SUBSTR = "serve/"
+
+_LOCK_CTORS = {"threading.Lock": "lock", "Lock": "lock",
+               "threading.RLock": "rlock", "RLock": "rlock"}
+_COND_CTORS = {"threading.Condition", "Condition"}
+_NONLOCK_SYNC = {"Event", "Semaphore", "Barrier"}  # not mutual exclusion
+
+
+class _ClassModel:
+    def __init__(self, mi, cdef: ast.ClassDef):
+        self.mi = mi
+        self.cdef = cdef
+        self.key = f"{mi.name}.{cdef.name}"
+        self.locks: dict[str, str] = {}       # attr -> canonical attr
+        self.lock_kinds: dict[str, str] = {}  # canonical attr -> lock|rlock
+        self.attr_types: dict[str, str] = {}  # attr -> class key
+        self._scan_init()
+
+    def lock_id(self, attr: str):
+        canon = self.locks.get(attr)
+        if canon is None:
+            return None
+        return (self.key, canon)
+
+    def _scan_init(self) -> None:
+        init = None
+        for sub in self.cdef.body:
+            if isinstance(sub, ast.FunctionDef) and sub.name == "__init__":
+                init = sub
+                break
+        if init is None:
+            return
+        ann: dict[str, ast.AST] = {
+            a.arg: a.annotation for a in init.args.args if a.annotation}
+        for node in ast.walk(init):
+            target = value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            attr = target.attr
+            if isinstance(value, ast.Call):
+                d = call_name(value)
+                if d in _LOCK_CTORS:
+                    self.locks[attr] = attr
+                    self.lock_kinds[attr] = _LOCK_CTORS[d]
+                    continue
+                if d in _COND_CTORS:
+                    arg = dotted_name(value.args[0]) if value.args else None
+                    if arg and arg.startswith("self."):
+                        wrapped = arg[5:]
+                        self.locks[attr] = self.locks.get(wrapped, wrapped)
+                    else:  # Condition() owns a private Lock
+                        self.locks[attr] = attr
+                        self.lock_kinds[attr] = "rlock"
+                    continue
+            if isinstance(node, ast.AnnAssign) and node.annotation is not None:
+                self.attr_types.setdefault(attr, ("__ann__", node.annotation))
+            elif isinstance(value, ast.Name) and value.id in ann:
+                self.attr_types.setdefault(
+                    attr, ("__ann__", ann[value.id]))
+            elif isinstance(value, ast.Call):
+                d = call_name(value)
+                if d:
+                    self.attr_types.setdefault(attr, ("__ctor__", d))
+
+
+class _Model:
+    """All serve-scope classes, locks, roots, and callback registry."""
+
+    def __init__(self, project: ProjectIndex):
+        self.project = project
+        self.modules = [mi for mi in project.modules.values()
+                        if SCOPE_SUBSTR in mi.fctx.relpath]
+        self.classes: dict[str, _ClassModel] = {}
+        self.module_locks: dict[tuple[str, str], str] = {}  # id -> kind
+        for mi in self.modules:
+            for cname, cdef in mi.classes.items():
+                cm = _ClassModel(mi, cdef)
+                self.classes[cm.key] = cm
+            for node in mi.fctx.tree.body:
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    d = call_name(node.value)
+                    if d in _LOCK_CTORS:
+                        lid = (mi.name, node.targets[0].id)
+                        self.module_locks[lid] = _LOCK_CTORS[d]
+        # callback registry + roots
+        self.callback_attrs: dict[str, list[FunctionInfo]] = {}
+        self.roots: list[tuple[str, FunctionInfo]] = []
+        self._find_roots()
+
+    # -- type resolution ---------------------------------------------------
+
+    def _resolve_class(self, mi, name: str | None):
+        if not name:
+            return None
+        tgt = mi.imports.get(name.split(".")[0], None)
+        candidates = [name]
+        if tgt:
+            candidates.append(tgt + name[len(name.split(".")[0]):])
+        for cand in candidates:
+            tail = cand.split(".")[-1]
+            for cm in self.classes.values():
+                if cm.cdef.name == tail:
+                    return cm
+        return None
+
+    def _annotation_class(self, mi, node: ast.AST):
+        if isinstance(node, ast.BinOp):  # X | None
+            return (self._annotation_class(mi, node.left)
+                    or self._annotation_class(mi, node.right))
+        if isinstance(node, ast.Subscript):  # Optional[X]
+            return self._annotation_class(mi, node.slice)
+        return self._resolve_class(mi, dotted_name(node))
+
+    def _heuristic_class(self, name: str):
+        if len(name) < 3:
+            return None
+        low = name.lower().replace("_", "")
+        for cm in self.classes.values():
+            if cm.cdef.name.lower().endswith(low):
+                return cm
+        return None
+
+    def attr_class(self, cm: _ClassModel, attr: str):
+        t = cm.attr_types.get(attr)
+        if t is not None:
+            kind, val = t
+            got = (self._annotation_class(cm.mi, val) if kind == "__ann__"
+                   else self._resolve_class(cm.mi, val))
+            if got is not None:
+                return got
+        return self._heuristic_class(attr)
+
+    def var_class(self, fi: FunctionInfo, name: str):
+        node = fi.node
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                if a.arg == name and a.annotation is not None:
+                    got = self._annotation_class(fi.module, a.annotation)
+                    if got is not None:
+                        return got
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and sub.targets[0].id == name
+                    and isinstance(sub.value, ast.Call)):
+                got = self._resolve_class(fi.module, call_name(sub.value))
+                if got is not None:
+                    return got
+        return self._heuristic_class(name)
+
+    def class_method(self, cm: _ClassModel, name: str):
+        return cm.mi.functions.get(f"{cm.cdef.name}.{name}")
+
+    def class_of(self, fi: FunctionInfo):
+        if fi.cls is None:
+            return None
+        return self.classes.get(f"{fi.module.name}.{fi.cls}")
+
+    # -- roots -------------------------------------------------------------
+
+    def _self_method(self, fi: FunctionInfo, value: ast.AST):
+        """FunctionInfo when ``value`` is ``self.M`` / ``self.a.M`` naming
+        a method in scope."""
+        d = dotted_name(value)
+        if not d or not d.startswith("self."):
+            return None
+        parts = d.split(".")
+        cm = self.class_of(fi)
+        if cm is None:
+            return None
+        if len(parts) == 2:
+            return self.class_method(cm, parts[1])
+        target = self.attr_class(cm, parts[1])
+        if target is not None:
+            return self.class_method(target, parts[-1])
+        return None
+
+    def _find_roots(self) -> None:
+        seen: set[tuple[str, str]] = set()
+
+        def add(label: str, fi: FunctionInfo | None):
+            if fi is not None and (label, str(fi.key)) not in seen:
+                seen.add((label, str(fi.key)))
+                self.roots.append((label, fi))
+
+        for mi in self.modules:
+            for fi in mi.functions.values():
+                tail = fi.qualname.rsplit(".", 1)[-1]
+                if not tail.startswith("_") and tail != "__init__":
+                    add("public", fi)
+        for mi in self.modules:
+            for fi in list(mi.functions.values()):
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        is_thread = (call_name(node) or "").split(
+                            ".")[-1] == "Thread"
+                        for k in node.keywords:
+                            tgt = self._self_method(fi, k.value)
+                            if tgt is None:
+                                continue
+                            if k.arg == "target":
+                                add(f"thread:{tgt.qualname}", tgt)
+                            elif is_thread is False:
+                                add(f"callback:{tgt.qualname}", tgt)
+                    elif (isinstance(node, ast.Assign)
+                          and len(node.targets) == 1
+                          and isinstance(node.targets[0], ast.Attribute)):
+                        tgt = self._self_method(fi, node.value)
+                        if tgt is not None:
+                            attr = node.targets[0].attr
+                            self.callback_attrs.setdefault(
+                                attr, []).append(tgt)
+                            add(f"callback:{tgt.qualname}", tgt)
+
+
+class _Analysis:
+    """One interprocedural walk from every root, recording lock edges,
+    attribute accesses, and ``*_locked`` call discipline."""
+
+    def __init__(self, model: _Model):
+        self.m = model
+        self.edges: dict[tuple, tuple] = {}      # (l1,l2) -> (fi, node)
+        self.reacquire: list[tuple] = []          # (lid, fi, node)
+        self.writes: dict[tuple, list] = {}       # (cls,attr) -> records
+        self.access_roots: dict[tuple, set] = {}  # (cls,attr) -> roots
+        self.unlocked_calls: list[tuple] = []     # (name, fi, node)
+        self._memo: set[tuple] = set()
+        self._stack: list[tuple] = []
+        for label, fi in model.roots:
+            self.run(fi, (), label)
+
+    # -- lock identities ---------------------------------------------------
+
+    def _lock_id(self, fi: FunctionInfo, expr: ast.AST):
+        d = dotted_name(expr)
+        if not d:
+            return None, None
+        if d.startswith("self."):
+            cm = self.m.class_of(fi)
+            if cm is None:
+                return None, None
+            attr = d.split(".")[1]
+            lid = cm.lock_id(attr)
+            if lid is None:
+                return None, None
+            kind = cm.lock_kinds.get(lid[1], "lock")
+            return lid, kind
+        if "." not in d:
+            lid = (fi.module.name, d)
+            if lid in self.m.module_locks:
+                return lid, self.m.module_locks[lid]
+        return None, None
+
+    def _acquire(self, fi, node, lid, kind, held):
+        for h in held:
+            if h == lid:
+                if kind == "lock":
+                    self.reacquire.append((lid, fi, node))
+                return held  # reentrant: no self-edge
+        for h in held:
+            self.edges.setdefault((h, lid), (fi, node))
+        return held + (lid,)
+
+    # -- call resolution ---------------------------------------------------
+
+    def _targets(self, fi: FunctionInfo, call: ast.Call):
+        d = call_name(call)
+        if not d:
+            return []
+        parts = d.split(".")
+        out = []
+        if parts[0] == "self":
+            cm = self.m.class_of(fi)
+            if cm is not None:
+                if len(parts) == 2:
+                    tgt = self.m.class_method(cm, parts[1])
+                    if tgt is not None:
+                        return [tgt]
+                    # callback attribute: self._on_terminal(...)
+                    return list(self.m.callback_attrs.get(parts[1], []))
+                target = self.m.attr_class(cm, parts[1])
+                if target is not None:
+                    tgt = self.m.class_method(target, parts[2])
+                    if tgt is not None:
+                        return [tgt]
+            return out
+        if len(parts) >= 2:
+            vcm = self.m.var_class(fi, parts[0])
+            if vcm is not None:
+                tgt = self.m.class_method(vcm, parts[1])
+                if tgt is not None:
+                    return [tgt]
+        # plain / imported function
+        for tgt in self.m.project._resolve_call(fi.module, fi.cls, d):
+            if SCOPE_SUBSTR in tgt.module.fctx.relpath:
+                out.append(tgt)
+        return out
+
+    # -- the walk ----------------------------------------------------------
+
+    def run(self, fi: FunctionInfo, held: tuple, root: str) -> None:
+        key = (str(fi.key), held, root)
+        if key in self._memo or key in self._stack:
+            return
+        self._stack.append(key)
+        try:
+            body = getattr(fi.node, "body", None)
+            if isinstance(body, list):
+                self._block(fi, body, held, root)
+        finally:
+            self._stack.pop()
+            self._memo.add(key)
+
+    def _block(self, fi, stmts, held, root):
+        for s in stmts:
+            self._stmt(fi, s, held, root)
+
+    def _stmt(self, fi, node, held, root):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                self._expr(fi, item.context_expr, new_held, root)
+                if isinstance(item.context_expr, ast.Call):
+                    continue  # with ctxmgr(...) — not a bare lock
+                lid, kind = self._lock_id(fi, item.context_expr)
+                if lid is not None:
+                    new_held = self._acquire(fi, node, lid, kind, new_held)
+            self._block(fi, node.body, new_held, root)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._block(fi, node.body, (), root)  # closure: runs later
+            return
+        header = {
+            ast.If: ["test"], ast.While: ["test"],
+            ast.For: ["iter", "target"], ast.AsyncFor: ["iter", "target"],
+        }.get(type(node))
+        if header is not None:
+            for attr in header:
+                self._expr(fi, getattr(node, attr), held, root)
+            self._block(fi, node.body, held, root)
+            self._block(fi, getattr(node, "orelse", []) or [], held, root)
+            return
+        if isinstance(node, ast.Try):
+            self._block(fi, node.body, held, root)
+            for h in node.handlers:
+                self._block(fi, h.body, held, root)
+            self._block(fi, node.orelse, held, root)
+            self._block(fi, node.finalbody, held, root)
+            return
+        self._expr(fi, node, held, root)
+
+    def _expr(self, fi, node, held, root):
+        if node is None:
+            return
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._call(fi, n, held, root)
+            elif isinstance(n, ast.Attribute):
+                self._attr_access(fi, n, held, root)
+
+    def _call(self, fi, call, held, root):
+        d = call_name(call)
+        if d:
+            last = d.split(".")[-1]
+            if last == "acquire":
+                lid, kind = self._lock_id(fi, call.func.value)
+                if lid is not None:
+                    self._acquire(fi, call, lid, kind, held)
+                    return
+            if last.endswith("_locked") and not held:
+                self.unlocked_calls.append((d, fi, call))
+        for tgt in self._targets(fi, call):
+            self.run(tgt, held, root)
+
+    def _attr_access(self, fi, node: ast.Attribute, held, root):
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        cm = self.m.class_of(fi)
+        if cm is None or node.attr in cm.locks:
+            return
+        key = (cm.key, node.attr)
+        self.access_roots.setdefault(key, set()).add(root)
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            tail = fi.qualname.rsplit(".", 1)[-1]
+            if tail in ("__init__", "__enter__"):
+                return
+            self.writes.setdefault(key, []).append(
+                (fi, node, not held, root))
+
+
+def _analysis(project: ProjectIndex) -> "_Analysis":
+    """The walk is shared by all three rules; cache it per project."""
+    cached = getattr(project, "_lock_analysis", None)
+    if cached is None:
+        cached = _Analysis(_Model(project))
+        project._lock_analysis = cached
+    return cached
+
+
+def _find_cycles(edges: dict) -> list[list]:
+    graph: dict = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, seen_cycles = [], set()
+
+    def dfs(start, node, path, visited):
+        for nxt in graph.get(node, ()):
+            if nxt == start:
+                canon = frozenset(path)
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(path[:])
+            elif nxt not in visited and len(path) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in graph:
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _fmt(lid) -> str:
+    return f"{lid[0].split('.')[-1]}.{lid[1]}"
+
+
+class LockOrderCycle:
+    """A cycle in the static lock-acquisition graph, or re-acquiring a
+    held non-reentrant Lock — either one can deadlock at runtime."""
+
+    name = "lock-order-cycle"
+
+    def run(self, project: ProjectIndex):
+        ana = _analysis(project)
+        for cycle in _find_cycles(ana.edges):
+            pair = (cycle + cycle[:1])[:2]
+            fi, node = ana.edges.get(
+                (pair[0], pair[1]), next(iter(ana.edges.values())))
+            order = " -> ".join(_fmt(c) for c in cycle + cycle[:1])
+            yield project.finding(
+                self.name, fi, node,
+                f"lock acquisition cycle {order}: threads taking these "
+                f"locks in different orders can deadlock")
+        for lid, fi, node in ana.reacquire:
+            yield project.finding(
+                self.name, fi, node,
+                f"re-acquiring non-reentrant lock {_fmt(lid)} while "
+                f"already held: self-deadlock")
+
+
+class UnlockedSharedWrite:
+    """A ``self.attr`` write outside ``__init__`` with no lock held on
+    some path, for an attribute reachable from two or more distinct
+    thread roots — a data race unless a documented protocol protects
+    it (then: baseline with a justification)."""
+
+    name = "unlocked-shared-write"
+
+    def run(self, project: ProjectIndex):
+        ana = _analysis(project)
+        emitted = set()
+        for key, records in sorted(ana.writes.items()):
+            roots = ana.access_roots.get(key, set())
+            if len(roots) < 2:
+                continue
+            for fi, node, unlocked, root in records:
+                if not unlocked:
+                    continue
+                loc = (key, node.lineno, node.col_offset)
+                if loc in emitted:
+                    continue
+                emitted.add(loc)
+                others = sorted(r for r in roots if r != root)[:3]
+                yield project.finding(
+                    self.name, fi, node,
+                    f"unlocked write to shared `self.{key[1]}` (also "
+                    f"reached from {', '.join(others)})")
+
+
+class LockedSuffixCall:
+    """A ``*_locked``-named method called with no lock held — the
+    naming contract says the caller must already own the lock."""
+
+    name = "locked-suffix-call"
+
+    def run(self, project: ProjectIndex):
+        ana = _analysis(project)
+        emitted = set()
+        for d, fi, node in ana.unlocked_calls:
+            loc = (fi.module.fctx.relpath, node.lineno, d)
+            if loc in emitted:
+                continue
+            emitted.add(loc)
+            yield project.finding(
+                self.name, fi, node,
+                f"`{d}()` called without holding any lock; the _locked "
+                f"suffix requires the caller to own it")
+
+
+RULES = (LockOrderCycle, UnlockedSharedWrite, LockedSuffixCall)

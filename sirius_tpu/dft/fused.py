@@ -321,7 +321,8 @@ class FusedScf:
                     mag_new, tables["sym"], axial_z=True
                 )
         mag_moment = (
-            jnp.real(mag_new[0]) * omega if self.polarized else jnp.zeros(())
+            jnp.real(mag_new[0]) * omega if self.polarized
+            else jnp.zeros((), dtype=jnp.float64)
         )
 
         # mixing (host-sequence semantics: rms pre-mix, eha post-mix)
@@ -405,7 +406,7 @@ class FusedScf:
         if self.polarized:
             bz_re, bz_im = jnp.real(bz_new), jnp.imag(bz_new)
         else:
-            bz_re = bz_im = jnp.zeros(ng)
+            bz_re = bz_im = jnp.zeros(ng, dtype=jnp.float64)
         new_carry = FusedCarry(
             jnp.real(x_mixed), jnp.imag(x_mixed),
             state.hx_re, state.hx_im, state.hf_re, state.hf_im, state.count,

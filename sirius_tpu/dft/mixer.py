@@ -336,7 +336,7 @@ def device_mix(state: DeviceMixerState, x_in: jnp.ndarray, x_new: jnp.ndarray,
         out = x_in + beta * f
     else:
         m = max_history
-        valid = (jnp.arange(m) < state.count)[:, None]
+        valid = (jnp.arange(m, dtype=jnp.int32) < state.count)[:, None]
         hx = jnp.where(valid, jax.lax.complex(state.hx_re, state.hx_im), 0.0)
         hf = jnp.where(valid, jax.lax.complex(state.hf_re, state.hf_im), 0.0)
         dfs = jnp.where(valid, f[None, :] - hf, 0.0)

@@ -370,7 +370,8 @@ def generate_potential_device(
         "vloc": inner_rr(rho_r, to_r(vloc_g)),
         "veff": inner_rr(rho_r, to_r(veff_g)),
         "exc": inner_rr(rho_r + rho_core_r, exc_r),
-        "bxc": inner_rr(mag_r, to_r(bz_g)) if polarized else jnp.zeros(()),
+        "bxc": (inner_rr(mag_r, to_r(bz_g)) if polarized
+                else jnp.zeros((), dtype=jnp.float64)),
     }
     return {
         "veff_g": veff_g,

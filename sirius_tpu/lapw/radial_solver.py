@@ -164,7 +164,7 @@ def _jax_rk4(n: int, rel: str, has_src: bool):
 
         # scan xs leaves carry leading axis n-1; the per-step slice of a
         # [n-1, 3] coefficient array is [3], indexed by j inside f
-        live = jnp.arange(n - 1) < ncut
+        live = jnp.arange(n - 1, dtype=jnp.int32) < ncut
         xs = {
             "h": hsteps, "a_pq": a_pq, "a_qp": a_qp, "inv_r": inv_r,
             "live": live,
@@ -647,7 +647,7 @@ def _jax_dirac(n: int, store: bool):
                 (ypn, yqn, ls) if store else None
             )
 
-        live = jnp.arange(n - 1) < ncut
+        live = jnp.arange(n - 1, dtype=jnp.int32) < ncut
         xs = {"h": hsteps, "aPQ": aPQ, "aQP": aQP, "inv_r": inv_r,
               "live": live}
         carry, ys = jax.lax.scan(step, (p0, q0, 0, 0.0), xs)

@@ -50,6 +50,23 @@ PEAK_GBPS = {
 }
 CPU_CORE_GBPS = 6.4
 
+# Span names that deliberately have NO analytic flop model: wall-clock
+# orchestration spans (queue wait, whole-iteration envelopes, MD step
+# framing) where "achieved GFLOP/s" would be meaningless. sirius-lint's
+# uncosted-span rule requires every scf.*/md.*/serve.* span wired into
+# obs/spans.py to have either a scf_stage_costs() key or an entry here,
+# so a new span is an explicit decision, not silent 0-FLOP noise in the
+# attribution report.
+UNCOSTED_SPANS = (
+    "scf.setup",
+    "md.integrate",
+    "md.extrapolate",
+    "md.scf",
+    "serve.run",
+    "serve.compile",
+    "serve.queue_wait",
+)
+
 
 def detect_platform() -> str:
     """Backend platform string without forcing a jax init ("cpu" when

@@ -93,8 +93,9 @@ class SliceSupervisor:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        for state in self.workers:
-            self._spawn_locked(state, reason="start")
+        with self._lock:
+            for state in self.workers:
+                self._spawn_locked(state, reason="start")
         self._watchdog = threading.Thread(
             target=self._watch, name="serve-watchdog", daemon=True)
         self._watchdog.start()

@@ -118,3 +118,210 @@ def synthetic_silicon_context(
     finally:
         ucm.UnitCell.from_config = orig
     return ctx
+
+
+# --------------------------------------------------------------------------
+# Runtime lock-order monitor (sirius-lint's dynamic counterpart)
+#
+# The static lock rules in sirius_tpu.analysis.lockrules prove the absence
+# of ordering cycles over the *declared* call graph; this shim checks the
+# orders that actually happen at runtime, including paths the static model
+# cannot resolve (dynamic dispatch, callbacks crossing threads).  Within a
+# monitoring window every threading.Lock/RLock *created* in a matching
+# source file is wrapped; each acquisition while other monitored locks are
+# held records a directed edge (held -> acquired).  Seeing both A->B and
+# B->A — or any longer cycle — is a latent deadlock even if this particular
+# run never interleaved badly.
+
+import sys as _sys
+import threading as _threading
+
+
+class _MonitoredLock:
+    """Wraps a real Lock/RLock; delegates Condition's private protocol."""
+
+    def __init__(self, inner, name, monitor, reentrant):
+        self._sl_inner = inner
+        self._sl_name = name
+        self._sl_mon = monitor
+        self._sl_reentrant = reentrant
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._sl_inner.acquire(blocking, timeout)
+        if ok:
+            self._sl_mon._note_acquire(self)
+        return ok
+
+    def release(self):
+        self._sl_mon._note_release(self)
+        self._sl_inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._sl_inner.locked()
+
+    # Condition(lock) probes for these via hasattr and, finding them here,
+    # uses them for wait()'s release/reacquire — keep the held-stack honest.
+    def _release_save(self):
+        self._sl_mon._note_release(self, all_recursion=True)
+        inner = self._sl_inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        inner = self._sl_inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._sl_mon._note_acquire(self)
+
+    def _is_owned(self):
+        inner = self._sl_inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # plain Lock: non-blocking probe on the raw lock (not monitored)
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<MonitoredLock {self._sl_name}>"
+
+
+class LockOrderMonitor:
+    """Patch threading.Lock/RLock in a window and record acquisition order.
+
+    Usage::
+
+        with LockOrderMonitor(scope="sirius_tpu/serve") as mon:
+            ...exercise the code...
+        mon.assert_clean()
+
+    Only locks whose creation site's filename contains ``scope`` are
+    wrapped; everything else gets the real lock, so third-party code in
+    the window is unaffected.  Edges and violations survive ``__exit__``
+    (wrapped locks keep reporting), so a module-scoped pytest fixture can
+    assert once at teardown.
+    """
+
+    def __init__(self, scope: str = "sirius_tpu/serve"):
+        self.scope = scope
+        self.edges: dict[tuple[str, str], tuple[str, str]] = {}
+        self.violations: list[str] = []
+        self._tls = _threading.local()
+        self._state = _threading.Lock()  # guards edges/violations
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # -- patch window ------------------------------------------------------
+
+    def _creation_site(self):
+        f = _sys._getframe(2)
+        while f is not None:
+            fn = f.f_code.co_filename.replace("\\", "/")
+            if __file__.replace("\\", "/") != fn and "threading" not in fn:
+                return fn, f.f_lineno
+            f = f.f_back
+        return "<unknown>", 0
+
+    def _factory(self, orig, reentrant):
+        def make(*a, **kw):
+            inner = orig(*a, **kw)
+            fn, line = self._creation_site()
+            if self.scope not in fn:
+                return inner
+            name = f"{fn.rsplit('/sirius_tpu/', 1)[-1]}:{line}"
+            return _MonitoredLock(inner, name, self, reentrant)
+        return make
+
+    def __enter__(self):
+        self._orig_lock = _threading.Lock
+        self._orig_rlock = _threading.RLock
+        _threading.Lock = self._factory(self._orig_lock, reentrant=False)
+        _threading.RLock = self._factory(self._orig_rlock, reentrant=True)
+        return self
+
+    def __exit__(self, *exc):
+        _threading.Lock = self._orig_lock
+        _threading.RLock = self._orig_rlock
+        return False
+
+    # -- recording ---------------------------------------------------------
+
+    def _held(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, lock):
+        stack = self._held()
+        tname = _threading.current_thread().name
+        new = lock._sl_name
+        with self._state:
+            for held in stack:
+                if held is lock:
+                    continue  # RLock reentry: not an ordering edge
+                a, b = held._sl_name, new
+                if a == b:
+                    continue
+                self.edges.setdefault((a, b), (tname, ""))
+                if (b, a) in self.edges:
+                    other = self.edges[(b, a)][0]
+                    self.violations.append(
+                        f"lock-order inversion: {a} -> {b} (thread {tname})"
+                        f" vs {b} -> {a} (thread {other})"
+                    )
+        stack.append(lock)
+
+    def _note_release(self, lock, all_recursion=False):
+        stack = self._held()
+        if all_recursion:
+            self._tls.stack = [h for h in stack if h is not lock]
+            return
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    # -- verdict -----------------------------------------------------------
+
+    def _cycles(self):
+        graph: dict[str, set[str]] = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+        cycles, done = [], set()
+        def dfs(node, path, on_path):
+            if node in on_path:
+                cycles.append(path[path.index(node):])
+                return
+            if node in done:
+                return
+            on_path.add(node)
+            for nxt in graph.get(node, ()):
+                dfs(nxt, path + [nxt], on_path)
+            on_path.discard(node)
+            done.add(node)
+        for start in list(graph):
+            dfs(start, [start], set())
+        return cycles
+
+    def assert_clean(self):
+        problems = list(self.violations)
+        for cyc in self._cycles():
+            problems.append("lock-order cycle: " + " -> ".join(cyc))
+        if problems:
+            raise AssertionError(
+                "LockOrderMonitor found %d problem(s):\n  %s"
+                % (len(problems), "\n  ".join(sorted(set(problems))))
+            )

@@ -53,6 +53,25 @@ import numpy as np
 
 ACTIONS = ("nan", "raise", "exit", "flag")
 
+# The canonical fault-site registry: one entry per instrumented site in
+# the tree (the docstring above documents each). sirius-lint's
+# unknown-fault-site rule parses this tuple by AST, and
+# tools/chaos_serve.py validates its phase specs against it, so a typo'd
+# site in code or a chaos plan fails fast instead of silently never
+# firing. Add the site here in the same change that wires the hook.
+KNOWN_SITES = (
+    "scf.density",
+    "scf.potential",
+    "scf.evals",
+    "scf.band_stagnate",
+    "scf.autosave_kill",
+    "md.autosave_kill",
+    "checkpoint.before_rename",
+    "serve.worker_crash",
+    "serve.job_hang",
+    "serve.journal_torn",
+)
+
 
 class SimulatedKill(Exception):
     """In-process stand-in for SIGKILL/preemption (raised by 'raise' faults)."""

@@ -17,6 +17,18 @@ requires_mesh = pytest.mark.skipif(
     reason="needs the conftest virtual multi-device CPU mesh",
 )
 
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guard():
+    """Run the whole module under the runtime lock-order shim: every serve
+    lock created in this window records its acquisition order, and any
+    inversion/cycle observed across all tests fails at module teardown."""
+    from sirius_tpu.testing import LockOrderMonitor
+
+    with LockOrderMonitor(scope="sirius_tpu/serve") as mon:
+        yield mon
+    mon.assert_clean()
+
 PERTURBED = [[0.0, 0.0, 0.0], [0.252, 0.248, 0.252]]
 
 

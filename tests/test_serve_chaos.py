@@ -22,6 +22,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHAOS = os.path.join(REPO, "tools", "chaos_serve.py")
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guard():
+    """Chaos tests stress the supervisor/journal/queue interleavings; the
+    lock-order shim turns any inversion they provoke into a hard failure."""
+    from sirius_tpu.testing import LockOrderMonitor
+
+    with LockOrderMonitor(scope="sirius_tpu/serve") as mon:
+        yield mon
+    mon.assert_clean()
+
+
 def _mkjob(job_id="j", **kw):
     return Job({}, job_id=job_id, **kw)
 

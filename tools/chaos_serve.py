@@ -142,6 +142,7 @@ def child_main(args) -> int:
     from sirius_tpu.utils import faults
 
     if args.faults:
+        validate_fault_spec(args.faults)
         # in-process install (NOT the env var: run_scf re-arms the plan
         # from SIRIUS_TPU_FAULTS on every call, which would reset counts)
         faults.load_env(args.faults)
@@ -199,6 +200,22 @@ def child_main(args) -> int:
 
 # -- parent: the gauntlet --------------------------------------------------
 
+def validate_fault_spec(spec: str) -> None:
+    """Reject fault specs naming sites no code checks — a typo'd site makes
+    a chaos phase silently fault-free, which reads as a false pass.  The
+    authoritative list is faults.KNOWN_SITES (sirius-lint's unknown-fault-site
+    rule enforces the same registry statically)."""
+    from sirius_tpu.utils.faults import KNOWN_SITES
+
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        site = tok.partition(":")[0].partition("@")[0]
+        if site not in KNOWN_SITES:
+            raise SystemExit(
+                f"chaos_serve: unknown fault site {site!r} in spec {tok!r}; "
+                f"known sites: {', '.join(KNOWN_SITES)}"
+            )
+
+
 def spawn_child(wd: str, mode: str, jobs: int, slices: int,
                 faults: str = "", budget: float | None = None,
                 budget_first: bool = False,
@@ -212,6 +229,7 @@ def spawn_child(wd: str, mode: str, jobs: int, slices: int,
            "--poison", str(poison), "--backoff-base", str(backoff_base),
            "--timeout", str(timeout)]
     if faults:
+        validate_fault_spec(faults)
         cmd += ["--faults", faults]
     if budget is not None:
         cmd += ["--budget", str(budget)]
