@@ -4,7 +4,8 @@ Three registries keep names honest across subsystem boundaries:
 ``config/schema.py``'s ``ControlConfig`` fields (every ``control.*``
 read), ``utils/faults.py``'s ``KNOWN_SITES`` (every fault-injection
 site literal), and ``obs/costs.py``'s ``scf_stage_costs`` keys plus
-``UNCOSTED_SPANS`` (every ``scf.*``/``md.*``/``serve.*`` span name).
+``UNCOSTED_SPANS`` (every ``scf.*``/``md.*``/``serve.*``/``campaign.*``
+span name).
 Each registry is parsed *by AST* from the live source — never imported
 — so the lint works in any environment and the registries cannot drift
 from what the rule checks.
@@ -23,7 +24,7 @@ from sirius_tpu.analysis.core import (
     dotted_name,
 )
 
-_SPAN_RE = re.compile(r"^(scf|md|serve)\.[a-z_][a-z0-9_.]*$")
+_SPAN_RE = re.compile(r"^(scf|md|serve|campaign)\.[a-z_][a-z0-9_.]*$")
 
 
 @dataclasses.dataclass

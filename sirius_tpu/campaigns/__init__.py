@@ -1,0 +1,15 @@
+"""sirius_tpu.campaigns: DAG job graphs over the serving engine.
+
+A *campaign* is a DAG of SCF decks (CampaignSpec, spec.py) scheduled
+through serve/ with dependency-aware admission, durable journaled edges
+and cross-job warm-start handoff (handoff.py): a child node inherits its
+parent's converged ``(rho, psi)`` through ``run_scf(initial_guess=)``,
+with the delta-density transform for displaced geometries. Templates:
+finite-displacement Γ phonons (phonon.py), Birch–Murnaghan EOS volume
+sweeps (eos.py) and relax→SCF chains (chain.py). The ``sirius-campaign``
+CLI (cli.py) runs a campaign end-to-end and writes a JSON result.
+"""
+
+from sirius_tpu.campaigns.spec import (  # noqa: F401
+    CampaignNode, CampaignSpec, CampaignSpecError,
+)

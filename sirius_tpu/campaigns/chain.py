@@ -1,0 +1,68 @@
+"""Relax→SCF chain as a campaign template.
+
+Node ``relax`` runs fixed-cell BFGS (dft/relax.py, dispatched by the
+slice scheduler through the deck's top-level ``task: "relax"`` key) and
+records its *final* geometry and converged state in its handoff
+artifact. Node ``scf`` then runs at that relaxed geometry
+(``adopt_positions``) — typically with tighter tolerances or extra
+outputs — warm-started from the relaxed density/wave functions, so the
+production-quality SCF costs a handful of iterations instead of a full
+cold solve.
+"""
+
+from __future__ import annotations
+
+import json
+
+from sirius_tpu.campaigns.spec import CampaignNode, CampaignSpec
+
+
+def relax_scf_campaign(base_deck: dict, max_steps: int = 10,
+                       force_tol: float = 1e-4,
+                       final_overrides: dict | None = None,
+                       campaign_id: str = "chain") -> CampaignSpec:
+    """Two-node chain: relax the structure, then one final SCF at the
+    relaxed positions. ``final_overrides`` is merged section-by-section
+    into the final deck (e.g. {"parameters": {"energy_tol": 1e-12}})."""
+    relax_deck = json.loads(json.dumps(base_deck))
+    relax_deck["task"] = "relax"
+    relax_deck["relax"] = {
+        "max_steps": int(max_steps), "force_tol": float(force_tol)}
+    final_deck = json.loads(json.dumps(base_deck))
+    final_deck.pop("task", None)
+    for section, over in (final_overrides or {}).items():
+        if isinstance(over, dict):
+            merged = dict(final_deck.get(section) or {})
+            merged.update(over)
+            final_deck[section] = merged
+        else:
+            final_deck[section] = over
+    return CampaignSpec(
+        campaign_id=campaign_id, kind="chain",
+        nodes=[
+            CampaignNode(node_id="relax", deck=relax_deck),
+            CampaignNode(
+                node_id="scf", deck=final_deck, parents=["relax"],
+                warm_from="relax", displaced=True, adopt_positions=True),
+        ],
+        meta={"max_steps": int(max_steps), "force_tol": float(force_tol)},
+    )
+
+
+def finalize(spec: CampaignSpec, artifacts: dict) -> dict:
+    relax = artifacts.get("relax")
+    scf = artifacts.get("scf")
+    if relax is None or scf is None:
+        raise ValueError("chain finalize: relax and scf artifacts required")
+    out = {
+        "kind": "chain",
+        "relaxed_positions": [
+            [float(x) for x in row] for row in relax["positions"]],
+        "relax_energy_ha": float(relax["energy_total"]),
+        "final_energy_ha": float(scf["energy_total"]),
+        "final_scf_iterations": int(scf["num_scf_iterations"]),
+    }
+    summary = relax.get("summary") or {}
+    if isinstance(summary.get("relax"), dict):
+        out["relax"] = summary["relax"]
+    return out

@@ -1,0 +1,130 @@
+"""Equation-of-state volume sweep as a campaign template.
+
+Independent nodes at scaled lattice constants (no dependency edges: a
+volume change changes the G sets, so there is nothing to warm-start
+across — campaigns/handoff.py would detect the shape mismatch and
+cold-start anyway). Finalization fits the third-order Birch–Murnaghan
+E(V) form and reports V0, E0, B0 (GPa) and B0'. The same physics as the
+``sirius-scf --task eos`` mini-app (apps_util.run_eos), but scheduled as
+a DAG so the volume points run slice-parallel with journaled fault
+tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from sirius_tpu.campaigns.spec import (
+    CampaignNode, CampaignSpec, CampaignSpecError,
+)
+from sirius_tpu.campaigns.phonon import deck_geometry
+
+HA_BOHR3_TO_GPA = 29421.02648438959
+
+
+def _with_scale(deck: dict, scale: float) -> dict:
+    """The deck with every lattice vector scaled by ``scale`` (volume by
+    scale^3); fractional positions are volume-invariant."""
+    out = json.loads(json.dumps(deck))
+    if isinstance(out.get("synthetic"), dict) or "synthetic" in out:
+        syn = dict(out.get("synthetic") or {})
+        syn["a"] = float(syn.get("a", 10.26)) * scale
+        out["synthetic"] = syn
+        return out
+    uc = out.get("unit_cell")
+    if isinstance(uc, dict) and uc.get("lattice_vectors"):
+        uc = dict(uc)
+        uc["lattice_vectors_scale"] = (
+            float(uc.get("lattice_vectors_scale", 1.0)) * scale)
+        out["unit_cell"] = uc
+        return out
+    raise CampaignSpecError(
+        "eos_campaign: deck has neither a 'synthetic' section nor "
+        "unit_cell lattice_vectors")
+
+
+def eos_campaign(base_deck: dict, scale0: float = 0.94,
+                 scale1: float = 1.06, num_points: int = 7,
+                 campaign_id: str = "eos") -> CampaignSpec:
+    """Volume sweep: ``num_points`` linear-in-length scales spanning
+    [scale0, scale1] (volumes scale^3)."""
+    if num_points < 4:
+        raise CampaignSpecError(
+            "eos_campaign: the Birch-Murnaghan fit has 4 parameters — "
+            f"need >= 4 volume points, got {num_points}")
+    if not (0 < scale0 < scale1):
+        raise CampaignSpecError(
+            f"eos_campaign: need 0 < scale0 < scale1, got "
+            f"({scale0}, {scale1})")
+    lattice, _ = deck_geometry(base_deck)
+    v_base = float(abs(np.linalg.det(lattice)))
+    scales = np.linspace(float(scale0), float(scale1), int(num_points))
+    nodes = [
+        CampaignNode(
+            node_id=f"v{i}",
+            deck=_with_scale(base_deck, float(s)),
+            meta={"scale": float(s), "volume_bohr3": v_base * float(s) ** 3},
+        )
+        for i, s in enumerate(scales)
+    ]
+    return CampaignSpec(
+        campaign_id=campaign_id, kind="eos", nodes=nodes,
+        meta={"scales": scales.tolist(), "base_volume_bohr3": v_base},
+    )
+
+
+def birch_murnaghan(v, e0, v0, b0, b0p):
+    """Third-order Birch-Murnaghan E(V) [Ha, bohr^3]."""
+    v = np.asarray(v, dtype=np.float64)
+    eta = (v0 / v) ** (2.0 / 3.0)
+    return e0 + 9.0 * v0 * b0 / 16.0 * (
+        (eta - 1.0) ** 3 * b0p + (eta - 1.0) ** 2 * (6.0 - 4.0 * eta))
+
+
+def fit_birch_murnaghan(volumes, energies) -> dict:
+    """Least-squares BM3 fit; initial guess from a parabola in V."""
+    from scipy.optimize import curve_fit
+
+    v = np.asarray(volumes, dtype=np.float64)
+    e = np.asarray(energies, dtype=np.float64)
+    c2, c1, c0 = np.polyfit(v, e, 2)
+    if c2 <= 0:
+        raise ValueError(
+            "EOS fit: energies are not convex in volume — the sweep does "
+            "not bracket a minimum")
+    v0 = -c1 / (2.0 * c2)
+    p0 = [c0 + c1 * v0 + c2 * v0 ** 2, v0, 2.0 * c2 * v0, 4.0]
+    popt, pcov = curve_fit(birch_murnaghan, v, e, p0=p0, maxfev=20000)
+    e0, v0, b0, b0p = (float(x) for x in popt)
+    resid = e - birch_murnaghan(v, *popt)
+    return {
+        "e0_ha": e0,
+        "v0_bohr3": v0,
+        "b0_ha_bohr3": b0,
+        "b0_gpa": b0 * HA_BOHR3_TO_GPA,
+        "b0_prime": b0p,
+        "fit_rms_ha": float(np.sqrt(np.mean(resid ** 2))),
+    }
+
+
+def finalize(spec: CampaignSpec, artifacts: dict) -> dict:
+    """Fold the volume-node artifacts into the BM fit."""
+    vols, es, points = [], [], []
+    for n in spec.nodes:
+        art = artifacts.get(n.node_id)
+        if art is None:
+            continue
+        v = float(n.meta["volume_bohr3"])
+        e = float(art["energy_total"])
+        vols.append(v)
+        es.append(e)
+        points.append({"node": n.node_id, "scale": n.meta["scale"],
+                       "volume_bohr3": v, "energy_ha": e})
+    if len(vols) < 4:
+        raise ValueError(
+            f"EOS finalize: only {len(vols)} of {len(spec.nodes)} volume "
+            "points completed — not enough for the 4-parameter fit")
+    fit = fit_birch_murnaghan(vols, es)
+    return {"kind": "eos", "num_points": len(vols), "points": points, **fit}

@@ -1,0 +1,289 @@
+"""Cross-job warm-start handoff: the durable artifact a campaign parent
+leaves for its children.
+
+After a campaign node converges, the slice scheduler writes one ``.npz``
+per node (atomic tmp+rename, like io/checkpoint.py) holding the
+converged density and wave functions, the superposition-of-atoms density
+at the parent's positions, the positions/forces/energy, and a small JSON
+summary. A child node loads the artifact and turns it into a
+``run_scf(initial_guess=(rho, psi))`` pair:
+
+- same positions -> the parent density/psi verbatim;
+- displaced positions -> the QE-style delta-density transform
+  (dft/geometry.py::delta_density_guess): keep the parent's bonding
+  delta ``rho - rho_atomic(old)``, move the free-atom part to the new
+  positions via the child context's own ``rho_atomic_g``.
+
+Degradation is always graceful: a missing artifact, a shape mismatch
+(e.g. EOS nodes at different volumes have different G sets), or
+corruption (non-finite values — the ``campaign.handoff_corrupt`` fault
+site injects exactly this) downgrade to a cold start, never a failed
+job. run_scf raises ValueError on shape-mismatched guesses and the
+scheduler classifies ValueError as a permanent bad-deck failure, so
+every shape is validated here *before* it reaches run_scf.
+
+The artifact intentionally carries the node's scalar results (energy,
+forces, iteration count) too: campaign finalizers (phonon dynamical
+matrix, EOS fit) read them from disk, so a campaign that was SIGKILLed
+and replayed can still finalize even though the completed nodes'
+in-memory ``job.result`` died with the first process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from sirius_tpu.obs.log import get_logger
+from sirius_tpu.utils import faults
+
+logger = get_logger("campaigns")
+
+ARTIFACT_VERSION = 1
+
+
+class HandoffError(RuntimeError):
+    """The handoff artifact exists but is unusable (corrupt/non-finite).
+    Callers treat this as a cold start, not a job failure."""
+
+
+def artifact_path(workdir: str, campaign_id: str, node_id: str) -> str:
+    """Canonical artifact path for a node (journal-stable: replayed jobs
+    recompute the same path from the same ids)."""
+    return os.path.join(
+        str(workdir), f"handoff.{campaign_id}.{node_id}.npz")
+
+
+def save_artifact(path: str, ctx, result: dict, state: dict | None = None,
+                  positions=None) -> str:
+    """Write a node's handoff artifact atomically; returns ``path``.
+
+    ``state`` is the run_scf ``_state`` dict (rho_g/mag_g/psi); without
+    it the artifact still carries the scalars the finalizers need.
+    ``positions`` overrides the context positions (fractional) — the
+    relax template records its *final* geometry, not its starting one."""
+    pos = np.asarray(
+        positions if positions is not None else ctx.unit_cell.positions,
+        dtype=np.float64)
+    from sirius_tpu.md.integrator import AMU_TO_AU, masses_au
+
+    summary = {
+        "energy_total": float(result["energy"]["total"]),
+        "num_scf_iterations": int(result.get("num_scf_iterations") or 0),
+        "converged": bool(result.get("converged", False)),
+    }
+    if isinstance(result.get("relax"), dict):
+        summary["relax"] = {
+            k: v for k, v in result["relax"].items() if k != "history"}
+    arrs: dict = {
+        "version": np.int64(ARTIFACT_VERSION),
+        "positions": pos,
+        "masses_amu": masses_au(ctx.unit_cell) / AMU_TO_AU,
+        "energy_total": np.float64(summary["energy_total"]),
+        "num_scf_iterations": np.int64(summary["num_scf_iterations"]),
+        "summary_json": np.str_(json.dumps(summary, default=float)),
+    }
+    forces = result.get("forces")
+    if isinstance(forces, dict):
+        forces = forces.get("total")
+    if forces is not None:
+        arrs["forces"] = np.asarray(forces, dtype=np.float64)
+    if state is not None and state.get("rho_g") is not None:
+        arrs["rho_g"] = np.asarray(state["rho_g"], dtype=np.complex128)
+        # the free-atom superposition at the PARENT's positions,
+        # normalized exactly like the child's cold start will be — the
+        # "old" term of delta_density_guess
+        from sirius_tpu.dft.density import initial_density_g
+
+        arrs["rho_atomic_g"] = np.asarray(
+            initial_density_g(ctx), dtype=np.complex128)
+        if state.get("psi") is not None:
+            arrs["psi"] = np.asarray(state["psi"], dtype=np.complex128)
+        if state.get("mag_g") is not None:
+            arrs["mag_g"] = np.asarray(state["mag_g"], dtype=np.complex128)
+        scf = state.get("scf")
+        if isinstance(scf, dict) and scf.get("mix_x") is not None:
+            # the parent's quasi-Newton mixer history: a multisecant model
+            # of the SCF Jacobian the children import so their first mix()
+            # is already Anderson, not a plain damped step — this, not the
+            # density alone, is where most of the warm-start iteration
+            # savings come from
+            arrs["mix_x"] = np.asarray(scf["mix_x"], dtype=np.complex128)
+            arrs["mix_f"] = np.asarray(scf["mix_f"], dtype=np.complex128)
+            if scf.get("res_tol") is not None:
+                arrs["res_tol"] = np.float64(scf["res_tol"])
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrs)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return path
+
+
+def load_artifact(path: str) -> dict | None:
+    """The artifact as a plain dict (None when the file is absent)."""
+    if not os.path.exists(path):
+        return None
+    out: dict = {}
+    with np.load(path, allow_pickle=False) as data:
+        for k in data.files:
+            out[k] = data[k]
+    if "summary_json" in out:
+        try:
+            out["summary"] = json.loads(str(out.pop("summary_json")))
+        except ValueError:
+            out["summary"] = {}
+    return out
+
+
+def uniform_translation(pos_old, pos_new, atol: float = 1e-10):
+    """The single fractional vector t with pos_new = pos_old + t for EVERY
+    atom (mod lattice), or None. A uniform translation is an exact
+    symmetry of the Hamiltonian, so a parent artifact at pos_old is an
+    exact converged solution at pos_new after a G-space phase twist —
+    the strongest warm start a campaign edge can carry (finite-
+    displacement templates exploit it: in a 2-atom cell, displacing atom
+    1 by +h is the rigid translation of displacing atom 0 by -h)."""
+    pos_old = np.asarray(pos_old, dtype=np.float64)
+    pos_new = np.asarray(pos_new, dtype=np.float64)
+    if pos_old.shape != pos_new.shape or pos_old.ndim != 2:
+        return None
+    d = pos_new - pos_old
+    rel = d - d[0]
+    rel -= np.round(rel)  # compare mod 1: fractional coords may wrap
+    if np.max(np.abs(rel)) > atol:
+        return None
+    return d[0].copy()
+
+
+def load_guess(path: str, ctx, displaced: bool = True):
+    """``(rho, psi, scf_hint)`` for run_scf(initial_guess=) from a parent
+    artifact; ``scf_hint`` is the parent's mixer-history/band-tolerance
+    dict (None when the artifact predates it or the history is unusable).
+
+    Returns None for a cold start (artifact absent, densities not kept,
+    or every field shape-incompatible with the child context). Raises
+    HandoffError when the artifact is damaged (unreadable / non-finite
+    after the ``campaign.handoff_corrupt`` fault) — the caller logs it
+    and cold-starts. Every shape is validated against ``ctx`` here so a
+    mismatch degrades instead of tripping run_scf's ValueError, which
+    the scheduler would misread as a permanently bad deck."""
+    try:
+        art = load_artifact(path)
+    except (OSError, ValueError) as e:
+        raise HandoffError(f"unreadable handoff artifact {path}: {e}") from e
+    if art is None or art.get("rho_g") is None:
+        return None
+    rho = np.asarray(art["rho_g"], dtype=np.complex128)
+    rho = faults.corrupt("campaign.handoff_corrupt", 0, rho)
+    expected = ctx.rho_atomic_g.shape
+    if rho.shape != expected:
+        logger.info(
+            "handoff %s: density shape %s does not match the child G set "
+            "%s — cold start", path, rho.shape, expected)
+        return None
+    pos_old = np.asarray(art.get("positions"))
+    pos_new = np.asarray(ctx.unit_cell.positions)
+    moved = displaced and not np.allclose(pos_old, pos_new, atol=1e-12)
+    trans = uniform_translation(pos_old, pos_new) if moved else None
+    if moved and trans is not None:
+        # exact symmetry: rho'(r) = rho(r - t) -> rho'_G = rho_G e^{-2pi i
+        # G.t} (same convention as the structure factors, dft/density.py);
+        # the child starts AT the parent's converged fixed point
+        rho = rho * np.exp(-2j * np.pi
+                           * (np.asarray(ctx.gvec.millers) @ trans))
+    elif moved:
+        from sirius_tpu.dft.density import initial_density_g
+        from sirius_tpu.dft.geometry import delta_density_guess
+
+        rho_at_old = art.get("rho_atomic_g")
+        if rho_at_old is not None and rho_at_old.shape == expected:
+            rho = delta_density_guess(
+                rho, rho_at_old, initial_density_g(ctx))
+    if not np.all(np.isfinite(rho.view(np.float64))):
+        raise HandoffError(
+            f"handoff artifact {path}: non-finite density (corrupt)")
+    psi = art.get("psi")
+    if psi is not None:
+        want = (ctx.gkvec.num_kpoints, ctx.num_spins, ctx.num_bands,
+                ctx.gkvec.ngk_max)
+        if psi.shape != want:
+            psi = None
+        elif not np.all(np.isfinite(psi.view(np.float64))):
+            raise HandoffError(
+                f"handoff artifact {path}: non-finite psi (corrupt)")
+        elif trans is not None:
+            # Bloch coefficients at G+k pick up e^{-2pi i (G+k).t}
+            mk = (np.asarray(ctx.gkvec.millers)
+                  + np.asarray(ctx.gkvec.kpoints)[:, None, :])
+            psi = psi * np.exp(-2j * np.pi * (mk @ trans))[:, None, None, :]
+    scf_hint = None
+    hx, hf = art.get("mix_x"), art.get("mix_f")
+    if trans is not None:
+        # the translated guess is already the (phase-twisted) fixed point;
+        # the parent's untwisted mixer history would point the model at
+        # the untranslated problem, so it stays home
+        hx = hf = None
+    if (hx is not None and hf is not None and hx.ndim == 2
+            and hx.shape == hf.shape
+            and np.all(np.isfinite(hx.view(np.float64)))
+            and np.all(np.isfinite(hf.view(np.float64)))):
+        # run_scf itself re-validates the packed length against its own
+        # mix vector and drops the hint on mismatch, so a usable density
+        # with an unusable history still warm-starts. No geometry
+        # translation is needed: run_scf turns the rows into successive
+        # DIFFERENCES (secant pairs, Mixer.import_secants), and constant
+        # shifts cancel in differences.
+        scf_hint = {"mix_x": hx, "mix_f": hf}
+        if art.get("res_tol") is not None:
+            scf_hint["res_tol"] = float(art["res_tol"])
+    return (rho, psi, scf_hint)
+
+
+def adopt_positions(deck: dict, path: str) -> dict:
+    """The deck with its positions replaced by the parent artifact's
+    (relax→SCF chains run the child at the relaxed geometry). Supports
+    the ``synthetic`` section and ``unit_cell.atoms`` decks; raises
+    OSError when the artifact is missing — running the chain's final SCF
+    at the *unrelaxed* geometry would be a silently wrong answer, and
+    OSError is a retryable failure class in the scheduler."""
+    art = load_artifact(path)
+    if art is None:
+        raise OSError(f"handoff artifact not found: {path}")
+    pos = np.asarray(art["positions"], dtype=np.float64)
+    deck = json.loads(json.dumps(deck))  # deep copy, JSON-pure
+    if isinstance(deck.get("synthetic"), dict) or "synthetic" in deck:
+        syn = dict(deck.get("synthetic") or {})
+        syn["positions"] = pos.tolist()
+        deck["synthetic"] = syn
+        return deck
+    uc = deck.get("unit_cell")
+    if isinstance(uc, dict) and isinstance(uc.get("atoms"), dict):
+        atoms = uc["atoms"]
+        i = 0
+        out: dict = {}
+        for label, sites in atoms.items():
+            n = len(sites)
+            out[label] = pos[i:i + n].tolist()
+            i += n
+        if i != len(pos):
+            raise HandoffError(
+                f"adopt_positions: deck has {i} atoms, artifact has "
+                f"{len(pos)}")
+        uc = dict(uc)
+        uc["atoms"] = out
+        deck["unit_cell"] = uc
+        return deck
+    raise HandoffError(
+        "adopt_positions: deck has neither a 'synthetic' section nor "
+        "unit_cell.atoms")

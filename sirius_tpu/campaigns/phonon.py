@@ -1,0 +1,229 @@
+"""Finite-displacement Γ-point phonons as a campaign template.
+
+The textbook frozen-phonon recipe (reference SIRIUS drives it through
+its Python workflow layer; here it is a first-class campaign): one base
+SCF at the equilibrium geometry, then ``6·N_moved`` displaced decks —
+atom ``a`` moved by ``±h`` bohr along each Cartesian axis — every one a
+child of the base node, warm-started from its converged density through
+the delta-density handoff. All nodes share one compiled-executable
+bucket (same lattice, cutoffs and ``ngk_pad_quantum``), so the marginal
+cost of a displaced node is a warm SCF with zero compiles.
+
+Finalization builds the force-constant matrix by central differences,
+
+    C[3a+i, 3b+j] = -(F_bj(+h_ai) - F_bj(-h_ai)) / (2h),
+
+symmetrizes it, enforces the acoustic sum rule (the self-term absorbs
+minus the sum over partners, so uniform translations cost nothing), and
+diagonalizes the mass-weighted dynamical matrix D = C/sqrt(m_a m_b).
+Frequencies are reported in cm^-1 and THz; imaginary modes come out as
+negative numbers (sign(λ)·sqrt(|λ|)).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from sirius_tpu.campaigns.spec import (
+    CampaignNode, CampaignSpec, CampaignSpecError,
+)
+from sirius_tpu.md.integrator import AMU_TO_AU
+
+HA_TO_CM1 = 219474.6313702  # 1 Ha (= 1 a.u. angular frequency) in cm^-1
+CM1_TO_THZ = 0.0299792458
+
+_AXES = "xyz"
+
+
+def deck_geometry(deck: dict):
+    """(lattice[3,3] bohr, fractional positions[N,3]) of a deck.
+
+    Mirrors serve/scheduler.py::build_job_context for ``synthetic``
+    decks and config/schema.py for ``unit_cell`` decks; campaigns must
+    derive displaced nodes from the same geometry the scheduler will
+    build."""
+    syn = deck.get("synthetic")
+    if isinstance(syn, dict) or "synthetic" in deck:
+        syn = syn or {}
+        a = float(syn.get("a", 10.26))
+        lattice = a / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+        positions = np.asarray(
+            syn.get("positions", [[0.0, 0, 0], [0.25, 0.25, 0.25]]),
+            dtype=np.float64)
+        n = int(syn.get("supercell", 1))
+        if n > 1:
+            shifts = np.array(
+                [[i, j, k] for i in range(n)
+                 for j in range(n) for k in range(n)], dtype=np.float64)
+            positions = (
+                (positions[None, :, :] + shifts[:, None, :]) / n
+            ).reshape(-1, 3)
+            lattice = lattice * n
+        return lattice, positions
+    uc = deck.get("unit_cell")
+    if isinstance(uc, dict) and uc.get("lattice_vectors"):
+        scale = float(uc.get("lattice_vectors_scale", 1.0))
+        lattice = np.asarray(uc["lattice_vectors"], dtype=np.float64) * scale
+        pos = []
+        for sites in (uc.get("atoms") or {}).values():
+            pos.extend([list(map(float, s[:3])) for s in sites])
+        return lattice, np.asarray(pos, dtype=np.float64)
+    raise CampaignSpecError(
+        "deck has neither a 'synthetic' section nor unit_cell "
+        "lattice_vectors: cannot derive displaced geometries")
+
+
+def with_positions(deck: dict, positions) -> dict:
+    """A deep-copied deck at the given fractional positions."""
+    out = json.loads(json.dumps(deck))
+    pos = np.asarray(positions, dtype=np.float64).tolist()
+    if isinstance(out.get("synthetic"), dict) or "synthetic" in out:
+        syn = dict(out.get("synthetic") or {})
+        syn["positions"] = pos
+        out["synthetic"] = syn
+        return out
+    uc = dict(out["unit_cell"])
+    atoms = uc.get("atoms") or {}
+    i = 0
+    new_atoms = {}
+    for label, sites in atoms.items():
+        n = len(sites)
+        new_atoms[label] = pos[i:i + n]
+        i += n
+    uc["atoms"] = new_atoms
+    out["unit_cell"] = uc
+    return out
+
+
+def _with_forces(deck: dict) -> dict:
+    out = json.loads(json.dumps(deck))
+    ctl = dict(out.get("control") or {})
+    ctl["print_forces"] = True
+    out["control"] = ctl
+    return out
+
+
+def node_id_for(atom: int, axis: int, sign: int) -> str:
+    return f"d{atom}{_AXES[axis]}{'p' if sign > 0 else 'm'}"
+
+
+def phonon_campaign(base_deck: dict, displacement: float = 0.01,
+                    atoms: list[int] | None = None,
+                    campaign_id: str = "phonon") -> CampaignSpec:
+    """CampaignSpec for Γ-point finite-displacement phonons.
+
+    ``displacement`` is the Cartesian step in bohr; ``atoms`` restricts
+    which atoms are displaced (default: all — restrict only when
+    symmetry or cost arguments apply, e.g. chaos/bench runs)."""
+    lattice, positions = deck_geometry(base_deck)
+    natoms = len(positions)
+    moved = list(range(natoms)) if atoms is None else sorted(set(atoms))
+    for a in moved:
+        if not 0 <= a < natoms:
+            raise CampaignSpecError(
+                f"phonon_campaign: atom index {a} out of range "
+                f"(0..{natoms - 1})")
+    h = float(displacement)
+    if h <= 0:
+        raise CampaignSpecError("phonon_campaign: displacement must be > 0")
+    inv_lat = np.linalg.inv(lattice)
+    base = _with_forces(base_deck)
+    nodes = [CampaignNode(node_id="base", deck=base)]
+    from sirius_tpu.campaigns.handoff import uniform_translation
+
+    seen: list[tuple[str, np.ndarray]] = []  # displaced (node_id, pos)
+    for a in moved:
+        for i in range(3):
+            dfrac = h * inv_lat[i]  # cart h*e_i in fractional coords
+            for s in (+1, -1):
+                pos = positions.copy()
+                pos[a] = pos[a] + s * dfrac
+                # a displaced geometry that is an earlier node rigidly
+                # translated (2-atom cell: moving atom 1 by +h IS moving
+                # atom 0 by -h plus a uniform shift) warm-starts from THAT
+                # node: the handoff detects the translation and hands the
+                # child the exactly phase-twisted converged fields, so it
+                # converges in O(1) iterations instead of re-grinding the
+                # displacement response
+                src = next(
+                    (nid for nid, p in seen
+                     if uniform_translation(p, pos) is not None), "base")
+                nodes.append(CampaignNode(
+                    node_id=node_id_for(a, i, s),
+                    deck=with_positions(base, pos),
+                    parents=[src] if src != "base" else ["base"],
+                    warm_from=src,
+                    displaced=True,
+                    meta={"atom": a, "axis": i, "sign": s,
+                          **({"translation_of": src}
+                             if src != "base" else {})},
+                ))
+                seen.append((node_id_for(a, i, s), pos))
+    return CampaignSpec(
+        campaign_id=campaign_id, kind="phonon", nodes=nodes,
+        meta={"displacement": h, "natoms": natoms, "atoms": moved},
+    )
+
+
+def finalize(spec: CampaignSpec, artifacts: dict) -> dict:
+    """Fold the node artifacts into Γ frequencies.
+
+    ``artifacts`` maps node_id -> the dict campaigns/handoff.py
+    ``load_artifact`` returns (so finalization works equally from live
+    results and from a journal-replayed campaign's on-disk state)."""
+    h = float(spec.meta["displacement"])
+    moved = list(spec.meta["atoms"])
+    base = artifacts.get("base")
+    if base is None:
+        raise ValueError("phonon finalize: base node artifact missing")
+    natoms = len(np.asarray(base["positions"]))
+    masses = np.asarray(base["masses_amu"], dtype=np.float64) * AMU_TO_AU
+    if set(moved) != set(range(natoms)):
+        raise ValueError(
+            "phonon finalize: the dynamical matrix needs every atom "
+            f"displaced (moved {moved}, natoms {natoms})")
+    n3 = 3 * natoms
+    C = np.zeros((n3, n3))
+    for a in moved:
+        for i in range(3):
+            pair = []
+            for s in (+1, -1):
+                nid = node_id_for(a, i, s)
+                art = artifacts.get(nid)
+                if art is None or art.get("forces") is None:
+                    raise ValueError(
+                        f"phonon finalize: node {nid} has no forces "
+                        "(control.print_forces off, or the node never ran)")
+                pair.append(np.asarray(art["forces"], dtype=np.float64))
+            fp, fm = pair
+            C[3 * a + i, :] = -(fp - fm).reshape(-1) / (2.0 * h)
+    asr_violation = float(np.max(np.abs(
+        C.reshape(n3, natoms, 3).sum(axis=1))))
+    C = 0.5 * (C + C.T)
+    # acoustic sum rule: uniform translation must be a zero mode
+    for a in range(natoms):
+        for i in range(3):
+            row = C[3 * a + i].reshape(natoms, 3)
+            C[3 * a + i, 3 * a:3 * a + 3] -= row.sum(axis=0)
+    herm_err = float(np.max(np.abs(C - C.T)))
+    sqrt_m = np.sqrt(np.repeat(masses, 3))
+    D = C / np.outer(sqrt_m, sqrt_m)
+    D = 0.5 * (D + D.T)
+    evals = np.linalg.eigvalsh(D)
+    omega_au = np.sign(evals) * np.sqrt(np.abs(evals))
+    freq_cm1 = omega_au * HA_TO_CM1
+    acoustic = int(np.sum(np.abs(freq_cm1) < 5.0))
+    return {
+        "kind": "phonon",
+        "displacement_bohr": h,
+        "natoms": natoms,
+        "masses_amu": (np.asarray(base["masses_amu"])).tolist(),
+        "frequencies_cm1": freq_cm1.tolist(),
+        "frequencies_thz": (freq_cm1 * CM1_TO_THZ).tolist(),
+        "num_acoustic_near_zero": acoustic,
+        "asr_violation_ha_bohr2": asr_violation,
+        "symmetrization_error": herm_err,
+        "base_energy_ha": float(base["energy_total"]),
+    }

@@ -1,0 +1,216 @@
+"""Submit a CampaignSpec to a ServeEngine and fold the results.
+
+``submit_campaign`` walks the spec in topological order and submits one
+serve job per node, carrying the DAG metadata the queue needs
+(``parents`` for dependency admission) and the handoff plumbing the
+scheduler needs (``handoff_in``/``handoff_out`` artifact paths). With a
+journaled engine every edge is durable: a SIGKILL mid-campaign replays
+the un-finished nodes with their dependencies intact
+(``resume_campaign`` re-attaches a handle to the replayed graph), and
+completed nodes are *not* re-run — their artifacts on disk are what
+``finalize`` reads.
+
+Observability: ``campaign_submit`` / ``campaign_node_done`` /
+``campaign_done`` events carry the campaign id; metrics stay at
+bounded cardinality (``campaign_nodes_total{outcome}``,
+``campaign_wall_seconds{kind}``) because a per-campaign label is
+unbounded under real traffic — per-campaign progress lives in the
+event stream and ``CampaignHandle.status()``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from sirius_tpu.campaigns import chain as chain_mod
+from sirius_tpu.campaigns import eos as eos_mod
+from sirius_tpu.campaigns import handoff as handoff_mod
+from sirius_tpu.campaigns import phonon as phonon_mod
+from sirius_tpu.campaigns.spec import CampaignSpec
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import spans as obs_spans
+from sirius_tpu.serve.queue import Job, JobStatus
+
+_NODES = obs_metrics.REGISTRY.counter(
+    "campaign_nodes_total", "campaign node outcomes by template kind")
+_WALL = obs_metrics.REGISTRY.histogram(
+    "campaign_wall_seconds", "submit-to-finalize campaign wall time")
+
+
+def _generic_finalize(spec: CampaignSpec, artifacts: dict) -> dict:
+    return {
+        "kind": spec.kind,
+        "energies_ha": {
+            nid: float(art["energy_total"])
+            for nid, art in artifacts.items() if art is not None
+        },
+    }
+
+
+FINALIZERS = {
+    "phonon": phonon_mod.finalize,
+    "eos": eos_mod.finalize,
+    "chain": chain_mod.finalize,
+    "generic": _generic_finalize,
+}
+
+
+class CampaignHandle:
+    """A submitted (or replayed) campaign: wait, inspect, finalize."""
+
+    def __init__(self, engine, spec: CampaignSpec, workdir: str,
+                 jobs: dict[str, Job], prior_status: dict[str, str]):
+        self.engine = engine
+        self.spec = spec
+        self.workdir = workdir
+        #: node_id -> live Job (replay: only the nodes that re-entered
+        #: the queue; nodes terminal in a previous process are absent)
+        self.jobs = jobs
+        #: node_id -> terminal status settled in a previous process
+        self.prior_status = prior_status
+        self.submitted_at = time.time()
+
+    def node_status(self, node_id: str) -> str | None:
+        job = self.jobs.get(node_id)
+        if job is not None:
+            return job.status
+        return self.prior_status.get(node_id)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until every live node is terminal. False on timeout."""
+        deadline = None if timeout is None else time.time() + timeout
+        for job in self.jobs.values():
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return False
+            if not job.wait(remaining):
+                return False
+        return True
+
+    def status(self) -> dict:
+        nodes = {n.node_id: self.node_status(n.node_id)
+                 for n in self.spec.nodes}
+        done = sum(s == JobStatus.DONE for s in nodes.values())
+        terminal = sum(
+            s in (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED,
+                  JobStatus.SKIPPED_UPSTREAM)
+            for s in nodes.values())
+        return {
+            "campaign_id": self.spec.campaign_id,
+            "kind": self.spec.kind,
+            "nodes": nodes,
+            "num_nodes": len(nodes),
+            "num_done": done,
+            "num_terminal": terminal,
+        }
+
+    def artifacts(self) -> dict:
+        """node_id -> on-disk artifact dict (None when absent)."""
+        return {
+            n.node_id: handoff_mod.load_artifact(handoff_mod.artifact_path(
+                self.workdir, self.spec.campaign_id, n.node_id))
+            for n in self.spec.nodes
+        }
+
+    def finalize(self) -> dict:
+        """Fold the artifacts through the template finalizer. Reads from
+        disk, so it works identically after a journal replay."""
+        finalizer = FINALIZERS.get(self.spec.kind, _generic_finalize)
+        with obs_spans.span("campaign.finalize", template=self.spec.kind):
+            summary = finalizer(self.spec, self.artifacts())
+        wall = time.time() - self.submitted_at
+        _WALL.observe(wall, kind=self.spec.kind)
+        st = self.status()
+        obs_events.emit(
+            "campaign_done", campaign_id=self.spec.campaign_id,
+            campaign_kind=self.spec.kind, num_done=st["num_done"],
+            num_nodes=st["num_nodes"], wall_s=wall)
+        return summary
+
+    def result(self) -> dict:
+        """Status + finalizer output (finalizer errors are reported, not
+        raised: a partially-failed campaign still has a result)."""
+        out = self.status()
+        out["scf_iterations"] = {
+            nid: job.result.get("num_scf_iterations")
+            for nid, job in self.jobs.items()
+            if job.status == JobStatus.DONE and isinstance(job.result, dict)
+        }
+        try:
+            out["summary"] = self.finalize()
+        except (ValueError, KeyError) as e:
+            out["summary"] = None
+            out["finalize_error"] = str(e)
+        return out
+
+
+def _node_outcome_hook(job: Job) -> None:
+    _NODES.inc(outcome=job.status)
+    obs_events.emit(
+        "campaign_node_done", campaign_id=job.campaign_id,
+        node=job.node_id, job_id=job.id, status=job.status,
+        attempts=job.attempts)
+
+
+def submit_campaign(engine, spec: CampaignSpec,
+                    workdir: str | None = None,
+                    priority: int = 0) -> CampaignHandle:
+    """Validate and submit every node of ``spec`` (topological order, so
+    a parent is always journaled before its children)."""
+    spec.validate()
+    workdir = workdir or engine.workdir
+    cid = spec.campaign_id
+    obs_events.emit(
+        "campaign_submit", campaign_id=cid, campaign_kind=spec.kind,
+        num_nodes=len(spec.nodes),
+        nodes=[n.node_id for n in spec.nodes])
+    jobs: dict[str, Job] = {}
+    for node in spec.topo_order():
+        handoff_in = None
+        src = node.warm_from or (node.parents[0] if node.parents else None)
+        if src is not None:
+            handoff_in = {
+                "path": handoff_mod.artifact_path(workdir, cid, src),
+                "displaced": node.displaced,
+                "adopt_positions": node.adopt_positions,
+            }
+        job = engine.submit(
+            node.deck, job_id=spec.job_id(node.node_id),
+            priority=priority, base_dir=workdir,
+            parents=[spec.job_id(p) for p in node.parents],
+            campaign_id=cid, node_id=node.node_id,
+            handoff_in=handoff_in,
+            handoff_out=handoff_mod.artifact_path(
+                workdir, cid, node.node_id),
+        )
+        job.add_terminal_hook(_node_outcome_hook)
+        jobs[node.node_id] = job
+    return CampaignHandle(engine, spec, workdir, jobs, {})
+
+
+def resume_campaign(engine, spec: CampaignSpec,
+                    workdir: str | None = None) -> CampaignHandle:
+    """Re-attach to a journal-replayed campaign: nodes the previous
+    process finished stay finished (their terminal status comes from the
+    journal, their results from the handoff artifacts on disk); only the
+    replayed jobs are waited on."""
+    spec.validate()
+    workdir = workdir or engine.workdir
+    jobs: dict[str, Job] = {}
+    prior: dict[str, str] = {}
+    for node in spec.nodes:
+        jid = spec.job_id(node.node_id)
+        job = engine.queue.jobs.get(jid)
+        if job is not None:
+            job.add_terminal_hook(_node_outcome_hook)
+            jobs[node.node_id] = job
+        else:
+            status = engine.queue.external_parent_status.get(jid)
+            if status is not None:
+                prior[node.node_id] = status
+    obs_events.emit(
+        "campaign_resume", campaign_id=spec.campaign_id,
+        campaign_kind=spec.kind,
+        replayed=sorted(jobs), settled=sorted(prior))
+    return CampaignHandle(engine, spec, workdir, jobs, prior)

@@ -1,0 +1,172 @@
+"""CampaignSpec: a DAG of SCF decks with artifact handoff edges.
+
+A campaign is a set of *nodes* — each a full JSON deck in the cli.py
+format, usually derived from one base structure by a transform
+(displacement, volume scale, relaxation) — plus *edges*: a node's
+``parents`` must be terminal-DONE before it runs (serve/queue.py
+dependency admission), and ``warm_from`` names the parent whose
+converged ``(rho, psi)`` artifact seeds the child's SCF through
+``run_scf(initial_guess=)`` (campaigns/handoff.py).
+
+The spec is pure data (JSON round-trippable via ``to_dict``/
+``from_dict``); submission and artifact plumbing live in
+campaigns/runner.py, and the three stock templates — finite-displacement
+phonons, EOS volume sweeps, relax→SCF chains — in campaigns/phonon.py,
+eos.py and chain.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+class CampaignSpecError(ValueError):
+    """The spec is not a well-formed DAG (cycle, unknown parent, ...)."""
+
+
+@dataclasses.dataclass
+class CampaignNode:
+    """One job of the campaign DAG.
+
+    ``warm_from`` must be one of ``parents`` (default: the first parent);
+    ``displaced`` routes the handoff through the delta-density transform
+    (dft/geometry.py::delta_density_guess) when the child's positions
+    differ from the parent's; ``adopt_positions`` makes the child run at
+    the positions recorded in the parent artifact (relax→SCF chains)."""
+
+    node_id: str
+    deck: dict
+    parents: list[str] = dataclasses.field(default_factory=list)
+    warm_from: str | None = None
+    displaced: bool = True
+    adopt_positions: bool = False
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "deck": self.deck,
+            "parents": list(self.parents),
+            "warm_from": self.warm_from,
+            "displaced": self.displaced,
+            "adopt_positions": self.adopt_positions,
+            "meta": self.meta,
+        }
+
+
+@dataclasses.dataclass
+class CampaignSpec:
+    """A named DAG of deck nodes; ``kind`` selects the finalizer that
+    folds the per-node artifacts into campaign-level physics (phonon
+    frequencies, an EOS fit, ...)."""
+
+    campaign_id: str
+    kind: str = "generic"
+    nodes: list[CampaignNode] = dataclasses.field(default_factory=list)
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def node(self, node_id: str) -> CampaignNode:
+        for n in self.nodes:
+            if n.node_id == node_id:
+                return n
+        raise KeyError(f"campaign {self.campaign_id}: no node {node_id!r}")
+
+    def job_id(self, node_id: str) -> str:
+        """The serve job id of a node (campaign-scoped, journal-stable).
+        Dot-separated, never "/": job ids become autosave-file tags."""
+        return f"{self.campaign_id}.{node_id}"
+
+    def validate(self) -> None:
+        if not _ID_RE.match(self.campaign_id or ""):
+            raise CampaignSpecError(
+                f"bad campaign_id {self.campaign_id!r} (need "
+                f"[A-Za-z0-9][A-Za-z0-9._-]*)")
+        if not self.nodes:
+            raise CampaignSpecError(
+                f"campaign {self.campaign_id}: no nodes")
+        ids = [n.node_id for n in self.nodes]
+        seen: set[str] = set()
+        for nid in ids:
+            if not _ID_RE.match(nid or ""):
+                raise CampaignSpecError(
+                    f"campaign {self.campaign_id}: bad node_id {nid!r}")
+            if nid in seen:
+                raise CampaignSpecError(
+                    f"campaign {self.campaign_id}: duplicate node {nid!r}")
+            seen.add(nid)
+        for n in self.nodes:
+            if not isinstance(n.deck, dict):
+                raise CampaignSpecError(
+                    f"node {n.node_id}: deck must be a dict")
+            for p in n.parents:
+                if p not in seen:
+                    raise CampaignSpecError(
+                        f"node {n.node_id}: unknown parent {p!r}")
+                if p == n.node_id:
+                    raise CampaignSpecError(
+                        f"node {n.node_id}: depends on itself")
+            if n.warm_from is not None and n.warm_from not in n.parents:
+                raise CampaignSpecError(
+                    f"node {n.node_id}: warm_from {n.warm_from!r} is not "
+                    f"one of its parents {n.parents}")
+            if n.adopt_positions and not (n.warm_from or n.parents):
+                raise CampaignSpecError(
+                    f"node {n.node_id}: adopt_positions needs a parent")
+        self.topo_order()  # raises CampaignSpecError on a cycle
+
+    def topo_order(self) -> list[CampaignNode]:
+        """Kahn topological order (stable within a rank by spec order)."""
+        by_id = {n.node_id: n for n in self.nodes}
+        indeg = {n.node_id: len(set(n.parents)) for n in self.nodes}
+        children: dict[str, list[str]] = {n.node_id: [] for n in self.nodes}
+        for n in self.nodes:
+            for p in set(n.parents):
+                children[p].append(n.node_id)
+        ready = [n.node_id for n in self.nodes if indeg[n.node_id] == 0]
+        out: list[CampaignNode] = []
+        while ready:
+            nid = ready.pop(0)
+            out.append(by_id[nid])
+            for c in children[nid]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        if len(out) != len(self.nodes):
+            stuck = sorted(nid for nid, d in indeg.items() if d > 0)
+            raise CampaignSpecError(
+                f"campaign {self.campaign_id}: dependency cycle through "
+                f"{stuck}")
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "campaign_id": self.campaign_id,
+            "kind": self.kind,
+            "meta": self.meta,
+            "nodes": [n.to_dict() for n in self.nodes],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> CampaignSpec:
+        spec = cls(
+            campaign_id=d["campaign_id"],
+            kind=d.get("kind", "generic"),
+            meta=dict(d.get("meta") or {}),
+            nodes=[
+                CampaignNode(
+                    node_id=nd["node_id"],
+                    deck=nd["deck"],
+                    parents=list(nd.get("parents") or []),
+                    warm_from=nd.get("warm_from"),
+                    displaced=bool(nd.get("displaced", True)),
+                    adopt_positions=bool(nd.get("adopt_positions", False)),
+                    meta=dict(nd.get("meta") or {}),
+                )
+                for nd in d.get("nodes") or []
+            ],
+        )
+        spec.validate()
+        return spec

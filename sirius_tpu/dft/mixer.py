@@ -102,6 +102,10 @@ class Mixer:
             )
         self._x: list[np.ndarray] = []  # input history
         self._f: list[np.ndarray] = []  # residual history f = x_out - x_in
+        # transferred secant pairs (import_secants), materialized into
+        # (_x, _f) at the next mix() once the first residual is known
+        self._sx: list[np.ndarray] = []
+        self._sf: list[np.ndarray] = []
 
     def _inner(self, a: np.ndarray, b: np.ndarray) -> float:
         w = self.weight if self.weight is not None else 1.0
@@ -219,6 +223,16 @@ class Mixer:
 
     def mix(self, x_in: np.ndarray, x_out: np.ndarray) -> np.ndarray:
         f = x_out - x_in
+        if self._sx and not self._x:
+            # materialize transferred secants against the FIRST actual
+            # residual: the pair (x_in - dx_j, f - df_j) makes the
+            # difference-to-current blocks of every scheme below exactly
+            # (dx_j, df_j) — the donor's Jacobian model enters without any
+            # absolute residual claim (see import_secants)
+            self._x = [x_in - dx for dx in self._sx]
+            self._f = [f - df for df in self._sf]
+        self._sx = []
+        self._sf = []
         if self.kind == "linear" or not self._x:
             nxt = x_in + self.beta * f
         elif self.kind == "anderson":
@@ -243,6 +257,8 @@ class Mixer:
         mix() degrades gracefully to a plain damped step."""
         self._x = []
         self._f = []
+        self._sx = []
+        self._sf = []
 
     def export_history(self) -> dict:
         """(x, f) history as stacked arrays for checkpointing; empty dict
@@ -259,6 +275,23 @@ class Mixer:
             return
         self._x = [np.asarray(r) for r in hist["mix_x"]]
         self._f = [np.asarray(r) for r in hist["mix_f"]]
+
+    def import_secants(self, dxs, dfs) -> None:
+        """Seed the quasi-Newton model with secant pairs (dx_j, df_j) from
+        ANOTHER SCF run at a nearby geometry (cross-job warm start,
+        campaigns/handoff.py). Absolute (x, f) pairs must not be imported
+        across problems: they assert "the residual at the donor's fixed
+        point is zero", which is false by O(h) for the child, and the
+        least-squares solve then parks the trajectory there — a stall
+        lasting until the stale rows age out of max_history. Differences
+        carry only the Jacobian action (and are invariant under the
+        delta-density translation of the guess), so they stay valid. The
+        pairs are held pending and anchored at the child's first actual
+        (x_in, f) inside mix(); flush_history drops pending pairs too, so
+        the recovery ladder also clears a poisoned transfer."""
+        keep = max(self.max_history - 1, 0)
+        self._sx = [np.asarray(r) for r in dxs][-keep:] if keep else []
+        self._sf = [np.asarray(r) for r in dfs][-keep:] if keep else []
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ def relax_atoms(
     force_tol: float = 1e-4,
     ctx=None,
     exec_cache=None,
+    devices=None,
 ) -> dict:
     import sirius_tpu.context as cm
     from sirius_tpu.dft.geometry import (
@@ -63,7 +64,7 @@ def relax_atoms(
             )
         out = run_scf(
             cfg, ctx=c, initial_state=state, keep_state=True,
-            exec_cache=exec_cache,
+            exec_cache=exec_cache, devices=devices,
         )
         warm["state"] = out.get("_state")
         warm["rho_at"] = rho_at

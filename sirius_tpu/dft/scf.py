@@ -144,10 +144,17 @@ def run_scf(
 ) -> dict:
     """initial_state: optional in-memory warm start {rho_g, mag_g, psi}
     (e.g. the `_state` of a previous run_scf at nearby atomic positions,
-    used by relax/vcrelax between geometry steps). initial_guess: the
-    simple front door to the same machinery — a (rho_g, psi) pair (either
-    may be None) validated against the context shapes, e.g. an
-    extrapolated density and wave functions from an MD predictor.
+    used by relax/vcrelax between geometry steps); its optional "scf"
+    sub-dict {mix_x, mix_f, res_tol} re-seeds the quasi-Newton mixer
+    history and band tolerance (see initial_guess below). initial_guess:
+    the simple front door to the same machinery — a (rho_g, psi) pair
+    (either may be None) validated against the context shapes, e.g. an
+    extrapolated density and wave functions from an MD predictor; a
+    third element, the "scf" hint dict of a previous run's `_state`,
+    additionally imports that run's mixer (x, f) history — a multisecant
+    model of the SCF Jacobian that stays accurate at a nearby geometry,
+    so the first mix() of the warm run takes a quasi-Newton step instead
+    of a plain damped one (cross-job handoff, campaigns/handoff.py).
     keep_state: attach that
     state to the result as `_state` (costs a host copy of all wave
     functions; only geometry drivers ask for it). serial_bands: use the
@@ -311,19 +318,24 @@ def run_scf(
         resume_scf = state.get("scf")
         _resume_psi = state.get("psi")
     psi = None
+    guess_scf = None
     if initial_state is not None:
         rho_g = np.asarray(initial_state["rho_g"])
         if polarized and initial_state.get("mag_g") is not None:
             mag_g = np.asarray(initial_state["mag_g"])
         if paw is not None and initial_state.get("paw_dm") is not None:
             paw_dm = np.asarray(initial_state["paw_dm"])
+        guess_scf = initial_state.get("scf")
         prev_psi = initial_state.get("psi")
         if prev_psi is not None and prev_psi.shape == (
             nk, ns, nb, ctx.gkvec.ngk_max,
         ):
             psi = np.asarray(prev_psi) * ctx.gkvec.mask[:, None, None, :]
     if initial_guess is not None:
-        guess_rho, guess_psi = initial_guess
+        if len(initial_guess) == 3:
+            guess_rho, guess_psi, guess_scf = initial_guess
+        else:
+            guess_rho, guess_psi = initial_guess
         if guess_rho is not None:
             guess_rho = np.asarray(guess_rho)
             if guess_rho.shape != rho_g.shape:
@@ -696,6 +708,32 @@ def run_scf(
     # sit just above density_tol and stall tight decks at num_dft_iter
     res_tol = itsol.residual_tolerance
     it0 = 0
+    warm_secants = None
+    if guess_scf:
+        # --- cross-run warm start of the MIXER, not just the density: the
+        # successive differences of the donor's (x, f) history are secant
+        # pairs of the SCF Jacobian, which a small geometry/volume
+        # perturbation barely changes. Without them the warm density still
+        # pays a full Anderson ramp-up (the model builds one pair per
+        # iteration); with them the first mix() is already quasi-Newton.
+        # Only DIFFERENCES transfer (Mixer.import_secants explains why
+        # absolute pairs stall the child). The donor's final res_tol
+        # replaces the loose start of the adaptive band-tolerance schedule
+        # below — a warm density is past the regime the loose bar exists
+        # for. A length mismatch (different G set / extras layout) drops
+        # the hint silently: an optimization, never a correctness input. ---
+        hx = np.asarray(guess_scf.get("mix_x", ()))
+        hf = np.asarray(guess_scf.get("mix_f", ()))
+        if (hx.ndim == 2 and hx.shape[0] >= 2 and hx.shape == hf.shape
+                and hx.shape[1] == x_mix.size
+                and np.all(np.isfinite(hx.view(np.float64)))
+                and np.all(np.isfinite(hf.view(np.float64)))):
+            warm_secants = (np.diff(hx.astype(np.complex128), axis=0),
+                            np.diff(hf.astype(np.complex128), axis=0))
+            mixer.import_secants(*warm_secants)
+        hint_tol = guess_scf.get("res_tol")
+        if hint_tol is not None and np.isfinite(hint_tol) and hint_tol > 0:
+            res_tol = min(res_tol, float(hint_tol))
     if resume_scf is not None:
         # --- mid-SCF resume (control.autosave_every checkpoints): restore
         # the packed mixed vector, mixer history/backoff state, adaptive
@@ -1987,12 +2025,20 @@ def run_scf(
     if hub is not None:
         result["_hubbard_v"] = vhub  # ndarray, consumed by the band-path task
     if keep_state:
-        # in-memory state for warm starts across geometry steps
+        # in-memory state for warm starts across geometry steps; the "scf"
+        # sub-dict (mixer history + final band tolerance) lets the NEXT run
+        # warm-start the quasi-Newton model too, not just the density (fed
+        # back through initial_state= or initial_guess=(rho, psi, scf))
+        if fused is not None and fused_carry is not None:
+            _, _hist = fused.fetch_state(fused_carry, with_history=True)
+        else:
+            _hist = mixer.export_history()
         result["_state"] = {
             "rho_g": np.asarray(rho_g),
             "mag_g": None if mag_g is None else np.asarray(mag_g),
             "psi": np.asarray(psi),
             "paw_dm": None if paw_dm is None else np.asarray(paw_dm),
+            "scf": (dict(_hist, res_tol=float(res_tol)) if _hist else None),
         }
     if polarized:
         result["magnetisation"] = {

@@ -53,8 +53,8 @@ CPU_CORE_GBPS = 6.4
 # Span names that deliberately have NO analytic flop model: wall-clock
 # orchestration spans (queue wait, whole-iteration envelopes, MD step
 # framing) where "achieved GFLOP/s" would be meaningless. sirius-lint's
-# uncosted-span rule requires every scf.*/md.*/serve.* span wired into
-# obs/spans.py to have either a scf_stage_costs() key or an entry here,
+# uncosted-span rule requires every scf.*/md.*/serve.*/campaign.* span
+# wired into obs/spans.py to have a scf_stage_costs() key or entry here,
 # so a new span is an explicit decision, not silent 0-FLOP noise in the
 # attribution report.
 UNCOSTED_SPANS = (
@@ -65,6 +65,7 @@ UNCOSTED_SPANS = (
     "serve.run",
     "serve.compile",
     "serve.queue_wait",
+    "campaign.finalize",
 )
 
 

@@ -26,6 +26,13 @@ Event kinds emitted across the tree:
   durable job journal after a restart (serve/journal.py)
 - ``drain`` / ``abort`` — engine shutdown handing queued jobs back
 - ``trace_capture``  — profiler trace start/stop with the output dir
+- ``campaign_submit`` / ``campaign_resume`` — a campaign DAG entering
+  the engine: campaign_id, kind, num_nodes (campaigns/runner.py)
+- ``campaign_handoff`` — a node consuming its parent's warm-start
+  artifact: mode=warm|missing|corrupt_fallback, displaced
+- ``campaign_node_done`` — terminal node outcome: node_id, status,
+  warm_start, scf iterations
+- ``campaign_done``  — finalize summary: kind, num_done, wall seconds
 
 Unconfigured, ``emit`` is one attribute test — safe on every hot path.
 Configuration is process-wide (module-level) because producers span

@@ -42,6 +42,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 from sirius_tpu import obs
@@ -90,6 +91,9 @@ class ServeEngine:
         self._submitted: list[Job] = []
         self._shutdown = False
         self._obs_server = None
+        # wait_all blocks on this condition; every job's terminal hook
+        # notifies it, so completion latency is not quantized by polling
+        self._done_cv = threading.Condition()
         if events_path:
             obs.configure_events(events_path)
         self.journal: journal_mod.JobJournal | None = None
@@ -98,6 +102,11 @@ class ServeEngine:
             pending, jstats = journal_mod.replay(journal_path)
             self.journal = journal_mod.JobJournal(journal_path)
             self._journal_stats = jstats
+            # campaign children replayed below may depend on parents that
+            # settled in a previous process and so never re-enter the
+            # queue: resolve those edges from the journal's terminal map
+            self.queue.external_parent_status.update(
+                jstats.get("terminal_status") or {})
             for rec in pending:
                 self.replayed.append(self._replay_job(rec))
         if metrics_port is not None:
@@ -106,6 +115,11 @@ class ServeEngine:
                 port=metrics_port, health_fn=self._health,
                 default_trace_dir=os.path.join(workdir, "trace_capture"),
             )
+
+    def _notify_terminal(self, job: Job) -> None:
+        """Job terminal hook: wake wait_all promptly."""
+        with self._done_cv:
+            self._done_cv.notify_all()
 
     # -- journal -----------------------------------------------------------
 
@@ -126,9 +140,15 @@ class ServeEngine:
             deadline=rec.get("deadline"),
             max_retries=int(rec.get("max_retries") or 2),
             wall_time_budget=rec.get("wall_time_budget"),
+            parents=rec.get("parents"),
+            campaign_id=rec.get("campaign_id"),
+            node_id=rec.get("node_id"),
+            handoff_in=rec.get("handoff_in"),
+            handoff_out=rec.get("handoff_out"),
         )
         job.resume_path = self._find_replay_autosave(job)
-        job._on_terminal = self._journal_terminal
+        job.add_terminal_hook(self._journal_terminal)
+        job.add_terminal_hook(self._notify_terminal)
         job.submitted_at = rec.get("ts") or time.time()
         self._submitted.append(job)
         # requeue, not submit: the journal already admitted this work, so
@@ -171,14 +191,13 @@ class ServeEngine:
         return self._obs_server.url if self._obs_server else None
 
     def _health(self) -> dict:
-        terminal = (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED)
         return {
             "ok": not self._shutdown,
             "num_slices": self.num_slices,
             "queue_depth": len(self.queue),
             "jobs_submitted": len(self._submitted),
             "jobs_in_flight": sum(
-                j.status not in terminal for j in self._submitted),
+                not j.terminal for j in self._submitted),
             "journal": self.journal.path if self.journal else None,
             "jobs_replayed": len(self.replayed),
             "uptime_s": (time.time() - self._t0) if self._t0 else 0.0,
@@ -188,17 +207,29 @@ class ServeEngine:
                priority: int = 0, deadline: float | None = None,
                base_dir: str | None = None, max_retries: int = 2,
                wall_time_budget: float | None = None,
-               block: bool = False, timeout: float | None = None) -> Job:
+               block: bool = False, timeout: float | None = None,
+               parents: list[str] | None = None,
+               campaign_id: str | None = None,
+               node_id: str | None = None,
+               handoff_in: dict | None = None,
+               handoff_out: str | None = None) -> Job:
         """Admit a job. Raises QueueFullError when the queue is bounded
         and full (immediately, or after ``timeout`` with ``block=True``).
-        With a journal, the submission is durable before it is queued."""
+        With a journal, the submission is durable before it is queued.
+        ``parents``/``campaign_id``/``handoff_*`` attach the job to a
+        campaign DAG (sirius_tpu.campaigns): it runs only after every
+        parent is DONE, is skipped terminally when one fails, and routes
+        the parent's converged state in as run_scf(initial_guess=)."""
         job = Job(
             deck, job_id=job_id, base_dir=base_dir or self.workdir,
             priority=priority, deadline=deadline, max_retries=max_retries,
             wall_time_budget=wall_time_budget,
+            parents=parents, campaign_id=campaign_id, node_id=node_id,
+            handoff_in=handoff_in, handoff_out=handoff_out,
         )
+        job.add_terminal_hook(self._notify_terminal)
         if self.journal is not None:
-            job._on_terminal = self._journal_terminal
+            job.add_terminal_hook(self._journal_terminal)
             # write-ahead: journal first so a crash between journaling and
             # queueing re-runs the job (at-least-once) instead of losing it
             job.submitted_at = time.time()
@@ -215,15 +246,25 @@ class ServeEngine:
         return job
 
     def wait_all(self, timeout: float | None = None) -> bool:
-        """Block until every submitted job is terminal. False on timeout."""
+        """Block until every submitted job is terminal. False on timeout.
+
+        Condition-based, not polled: each job's terminal hook notifies
+        ``_done_cv``, so a waiter wakes within the transition itself —
+        campaign completion latency is not quantized by a poll interval.
+        The pending set is re-evaluated on every wakeup, which also
+        covers jobs submitted after the wait began."""
         deadline = None if timeout is None else time.time() + timeout
-        for job in self._submitted:
-            remaining = None if deadline is None else deadline - time.time()
-            if remaining is not None and remaining <= 0:
-                return False
-            if not job.wait(remaining):
-                return False
-        return True
+        with self._done_cv:
+            while True:
+                # status is set before the hook fires, so any job whose
+                # notify we could have missed is already terminal here
+                if all(j.terminal for j in self._submitted):
+                    return True
+                remaining = (None if deadline is None
+                             else deadline - time.time())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._done_cv.wait(remaining)
 
     def shutdown(self, wait: bool = True, cleanup: bool = True,
                  mode: str = "drain") -> None:
@@ -273,6 +314,9 @@ class ServeEngine:
                 j.status == JobStatus.FAILED for j in self._submitted),
             "num_aborted": sum(
                 j.status == JobStatus.ABORTED for j in self._submitted),
+            "num_skipped_upstream": sum(
+                j.status == JobStatus.SKIPPED_UPSTREAM
+                for j in self._submitted),
             "num_quarantined": sum(
                 j.quarantined for j in self._submitted),
             "num_replayed": len(self.replayed),

@@ -13,7 +13,11 @@ full SCF).
 Record kinds::
 
     {"kind": "submit",   "job_id", "deck", "base_dir", "priority",
-     "deadline", "max_retries", "wall_time_budget", "ts"}
+     "deadline", "max_retries", "wall_time_budget", "ts",
+     # campaign DAG edges (present only on campaign nodes): the journal
+     # IS the durable copy of the graph — a SIGKILL mid-campaign replays
+     # the edges, not just the jobs
+     "campaign_id", "node_id", "parents", "handoff_in", "handoff_out"}
     {"kind": "terminal", "job_id", "status", "error", "permanent", "ts"}
 
 Crash-safety contract:
@@ -49,7 +53,7 @@ from sirius_tpu.utils import faults
 _RECORDS = obs_metrics.REGISTRY.counter(
     "serve_journal_records_total", "journal appends by record kind")
 
-TERMINAL_STATUSES = ("done", "failed", "aborted")
+TERMINAL_STATUSES = ("done", "failed", "aborted", "skipped_upstream")
 
 
 class JobJournal:
@@ -98,7 +102,7 @@ class JobJournal:
         _RECORDS.inc(kind=rec.get("kind", "unknown"))
 
     def record_submit(self, job) -> None:
-        self.append({
+        rec = {
             "kind": "submit",
             "job_id": job.id,
             "deck": job.deck,
@@ -108,7 +112,16 @@ class JobJournal:
             "max_retries": job.max_retries,
             "wall_time_budget": job.wall_time_budget,
             "ts": job.submitted_at,
-        })
+        }
+        if getattr(job, "campaign_id", None) or getattr(job, "parents", None):
+            rec.update(
+                campaign_id=job.campaign_id,
+                node_id=job.node_id,
+                parents=list(job.parents),
+                handoff_in=job.handoff_in,
+                handoff_out=job.handoff_out,
+            )
+        self.append(rec)
 
     def record_terminal(self, job) -> None:
         self.append({
@@ -132,12 +145,16 @@ def replay(path: str) -> tuple[list[dict], dict]:
 
     Returns ``(pending, stats)``: ``pending`` is the submit records (in
     original submit order, duplicates collapsed to the newest) that have
-    no terminal record after them; ``stats`` counts what was seen. Never
-    raises on a torn/garbled line — those are counted in
-    ``stats["torn_lines"]`` and skipped.
+    no terminal record after them; ``stats`` counts what was seen and
+    maps each terminally-settled job to its final status in
+    ``stats["terminal_status"]`` (how a replayed campaign child resolves
+    parents that finished in a previous process). Never raises on a
+    torn/garbled line — those are counted in ``stats["torn_lines"]`` and
+    skipped.
     """
     pending: dict[str, dict] = {}
-    stats = {"submits": 0, "terminals": 0, "torn_lines": 0}
+    stats = {"submits": 0, "terminals": 0, "torn_lines": 0,
+             "terminal_status": {}}
     if not os.path.exists(path):
         return [], stats
     with open(path, encoding="utf-8") as fh:
@@ -158,11 +175,16 @@ def replay(path: str) -> tuple[list[dict], dict]:
             if kind == "submit":
                 stats["submits"] += 1
                 pending[job_id] = rec
+                # a resubmitted id supersedes its earlier terminal record
+                stats["terminal_status"].pop(job_id, None)
             elif kind == "terminal":
                 stats["terminals"] += 1
                 pending.pop(job_id, None)
+                stats["terminal_status"][job_id] = rec.get("status")
     out = list(pending.values())
     if out:
-        obs_events.emit("journal_replay", path=str(path),
-                        pending=[r["job_id"] for r in out], **stats)
+        obs_events.emit(
+            "journal_replay", path=str(path),
+            pending=[r["job_id"] for r in out],
+            **{k: v for k, v in stats.items() if k != "terminal_status"})
     return out, stats

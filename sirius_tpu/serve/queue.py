@@ -33,6 +33,25 @@ Fault-tolerance semantics (ISSUE 8):
   transition after done/failed/aborted — a hung worker abandoned by the
   watchdog cannot resurrect or clobber a job that was already requeued,
   quarantined, or drained.
+
+Campaign DAG semantics (ISSUE 10):
+
+- **Dependency-aware admission.** A job with ``parents`` becomes
+  poppable only once every parent is terminal-DONE. ``pop()`` defers
+  dependency-blocked entries exactly like backoff-deferred ones; a
+  terminal transition on any job notifies ``_not_empty`` so a worker
+  promptly re-scans the heap for newly-unblocked children.
+- **Upstream-failure propagation.** A parent that ends failed, aborted
+  or skipped transitions the child to the terminal
+  ``SKIPPED_UPSTREAM`` status inside ``pop()`` — the cascade is lazy
+  (evaluated when the child reaches the front) and transitive: a
+  skipped parent skips its own children in turn.
+- **External parents.** After a journal replay, a child's parent may
+  have finished in a previous process and so never re-enters
+  ``jobs``. ``external_parent_status`` (job_id -> terminal status,
+  populated by the engine from the journal) resolves those edges; an
+  unknown parent is treated as satisfied rather than deadlocking the
+  child forever.
 """
 
 from __future__ import annotations
@@ -73,9 +92,13 @@ class JobStatus:
     DONE = "done"
     FAILED = "failed"
     ABORTED = "aborted"
+    # terminal state of a campaign node whose upstream dependency ended
+    # failed/aborted/skipped: the node never ran and never will
+    SKIPPED_UPSTREAM = "skipped_upstream"
 
 
-TERMINAL = (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED)
+TERMINAL = (JobStatus.DONE, JobStatus.FAILED, JobStatus.ABORTED,
+            JobStatus.SKIPPED_UPSTREAM)
 
 
 class Job:
@@ -84,7 +107,12 @@ class Job:
     def __init__(self, deck: dict, job_id: str | None = None,
                  base_dir: str = ".", priority: int = 0,
                  deadline: float | None = None, max_retries: int = 2,
-                 wall_time_budget: float | None = None):
+                 wall_time_budget: float | None = None,
+                 parents: list[str] | None = None,
+                 campaign_id: str | None = None,
+                 node_id: str | None = None,
+                 handoff_in: dict | None = None,
+                 handoff_out: str | None = None):
         self.id = job_id or f"job-{id(self):x}"
         self.deck = deck
         self.base_dir = base_dir
@@ -94,6 +122,16 @@ class Job:
         # per-attempt wall-time budget enforced by the supervisor watchdog
         # (None falls back to the scheduler default; 0/None = unbounded)
         self.wall_time_budget = wall_time_budget
+        # campaign DAG metadata: this job is poppable only once every id
+        # in ``parents`` is terminal-DONE; a failed parent skips it
+        self.parents = list(parents) if parents else []
+        self.campaign_id = campaign_id
+        self.node_id = node_id
+        # handoff_in: {"path", "displaced", "adopt_positions"} — load the
+        # parent artifact at ``path`` as run_scf(initial_guess=);
+        # handoff_out: artifact path this job writes on DONE
+        self.handoff_in = dict(handoff_in) if handoff_in else None
+        self.handoff_out = handoff_out
         self.status = JobStatus.QUEUED
         self.events: list[tuple[float, str, str]] = []
         self.result: dict | None = None
@@ -114,8 +152,16 @@ class Job:
         # workers capture the epoch at pickup and discard stale results
         self._epoch = 0
         self._cfg = None  # parsed Config cached by the scheduler (retries)
-        self._on_terminal = None  # engine hook (journal terminal record)
+        # fired in order on the terminal transition (journal record,
+        # queue dependency wakeup, engine wait_all notify, ...)
+        self._terminal_hooks: list = []
         self._done = threading.Event()
+
+    def add_terminal_hook(self, hook) -> None:
+        """Register ``hook(job)`` to fire once on the terminal transition
+        (idempotent: re-registering the same hook is a no-op)."""
+        if hook not in self._terminal_hooks:
+            self._terminal_hooks.append(hook)
 
     def _transition(self, status: str, detail: str = "") -> None:
         if self.status in TERMINAL:
@@ -131,15 +177,16 @@ class Job:
         self.status = status
         self.events.append((now, status, detail))
         _TRANSITIONS.inc(status=status)
+        extra = {"campaign_id": self.campaign_id} if self.campaign_id else {}
         obs_events.emit("job_transition", job_id=self.id, status=status,
-                        detail=detail, attempt=self.attempts)
+                        detail=detail, attempt=self.attempts, **extra)
         if status in TERMINAL:
             self.finished_at = now
             if self.submitted_at is not None:
                 _LATENCY.observe(now - self.submitted_at, outcome=status)
-            if self._on_terminal is not None:
+            for hook in list(self._terminal_hooks):
                 try:
-                    self._on_terminal(self)
+                    hook(self)
                 except Exception:
                     logger.exception("job %s terminal hook failed", self.id)
             self._done.set()
@@ -163,6 +210,9 @@ class Job:
         return {
             "id": self.id,
             "status": self.status,
+            "campaign_id": self.campaign_id,
+            "node_id": self.node_id,
+            "parents": list(self.parents),
             "priority": self.priority,
             "attempts": self.attempts,
             "poison_strikes": self.poison_strikes,
@@ -181,7 +231,10 @@ class JobQueue:
     deadline, then submit order), with optional bounded admission."""
 
     def __init__(self, maxsize: int = 0):
-        self._lock = threading.Lock()
+        # reentrant: a terminal transition inside pop() (deadline abort,
+        # upstream-skip propagation) fires hooks that may re-enter the
+        # queue lock to wake dependency waiters
+        self._lock = threading.RLock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._heap: list[tuple] = []
@@ -189,6 +242,9 @@ class JobQueue:
         self._closed = False
         self.maxsize = int(maxsize)  # 0 = unbounded
         self.jobs: dict[str, Job] = {}
+        # journal-replay edge resolution: terminal statuses of jobs that
+        # finished in a previous process and are not in ``jobs``
+        self.external_parent_status: dict[str, str] = {}
         self.high_water = 0
 
     @property
@@ -202,6 +258,27 @@ class JobQueue:
             self.high_water = depth
         _DEPTH.set(depth)
         _DEPTH_HW.max(depth)
+
+    def _wake_on_terminal(self, job: Job) -> None:
+        """Job terminal hook: a terminal transition may unblock
+        dependency-deferred children, so re-wake every pop() waiter."""
+        with self._lock:
+            self._not_empty.notify_all()
+
+    def _dep_state_locked(self, job: Job):
+        """None when every parent is DONE (or unknown — resolved as
+        satisfied so a half-replayed graph cannot deadlock); otherwise
+        ``("wait"|"skip", parent_id, parent_status)``."""
+        for pid in job.parents:
+            parent = self.jobs.get(pid)
+            status = (parent.status if parent is not None
+                      else self.external_parent_status.get(pid))
+            if status is None or status == JobStatus.DONE:
+                continue
+            if status in TERMINAL:
+                return ("skip", pid, status)
+            return ("wait", pid, status)
+        return None
 
     def _push_locked(self, job: Job) -> None:
         heapq.heappush(self._heap, (
@@ -237,6 +314,7 @@ class JobQueue:
                 if self._closed:
                     raise RuntimeError("queue is closed")
             job.submitted_at = time.time()
+            job.add_terminal_hook(self._wake_on_terminal)
             job._transition(JobStatus.QUEUED)
             self.jobs[job.id] = job
             self._push_locked(job)
@@ -251,6 +329,7 @@ class JobQueue:
             if self._closed:
                 job._transition(JobStatus.ABORTED, "queue closed")
                 return
+            job.add_terminal_hook(self._wake_on_terminal)
             job._transition(JobStatus.QUEUED, detail)
             self.jobs.setdefault(job.id, job)
             self._push_locked(job)
@@ -258,7 +337,11 @@ class JobQueue:
     def pop(self, timeout: float | None = None) -> Job | None:
         """Next runnable job; None on timeout or when closed and drained.
         Deadline-expired jobs are aborted here, never returned; jobs whose
-        backoff bar (``not_before``) is still in the future stay queued."""
+        backoff bar (``not_before``) is still in the future stay queued.
+        Dependency-blocked jobs (non-DONE parents) likewise stay queued
+        until a parent's terminal transition wakes the waiters; a parent
+        that ended failed/aborted/skipped terminally skips the child with
+        ``SKIPPED_UPSTREAM`` instead of ever running it."""
         bar = None if timeout is None else time.time() + timeout
         with self._not_empty:
             while True:
@@ -280,6 +363,21 @@ class JobQueue:
                         if next_ready is None or job.not_before < next_ready:
                             next_ready = job.not_before
                         continue
+                    if job.parents:
+                        dep = self._dep_state_locked(job)
+                        if dep is not None:
+                            state, pid, pstatus = dep
+                            if state == "skip":
+                                self._depth_changed_locked()
+                                self._not_full.notify()
+                                job._transition(
+                                    JobStatus.SKIPPED_UPSTREAM,
+                                    f"parent {pid} {pstatus}")
+                                continue
+                            # parent still pending/running: stays queued
+                            # until a terminal transition wakes us
+                            deferred.append(entry)
+                            continue
                     picked = job
                     break
                 for entry in deferred:

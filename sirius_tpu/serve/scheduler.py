@@ -28,6 +28,16 @@ cycle, register the job they run, and are respawned by the watchdog when
 they die or hang. Each attempt captures ``job._epoch`` at pickup; a
 worker whose job was taken away by the watchdog discards its outcome
 instead of clobbering the job's new life.
+
+Campaign nodes (sirius_tpu.campaigns) ride the same path with three
+extra steps: a ``handoff_in`` artifact is loaded into
+``run_scf(initial_guess=)`` (degrading to a cold start on damage or
+shape mismatch — campaigns/handoff.py), a top-level ``task: "relax"``
+deck key dispatches dft/relax.py instead of a single SCF, and on DONE a
+``handoff_out`` artifact is written *before* the terminal transition so
+the journal's DONE record always implies a durable artifact for the
+children. The ``campaign.node_fail`` fault site preempts a node attempt
+before its SCF to drive the SKIPPED_UPSTREAM cascade in tests.
 """
 
 from __future__ import annotations
@@ -60,6 +70,9 @@ _FAILURES = obs_metrics.REGISTRY.counter(
     "serve_job_failures_total", "terminal job failures")
 _BACKOFF = obs_metrics.REGISTRY.histogram(
     "serve_backoff_seconds", "retry backoff delays by failure class")
+_NODE_ITERS = obs_metrics.REGISTRY.counter(
+    "campaign_node_scf_iterations_total",
+    "SCF iterations spent on campaign nodes, by warm/cold handoff")
 
 # SimulationContext building for synthetic decks monkeypatches
 # UnitCell.from_config (testing.py idiom); serialize every context build
@@ -214,6 +227,34 @@ class SliceScheduler:
             return True
         return False
 
+    def _load_handoff(self, job: Job, ctx):
+        """Load the parent artifact named by ``job.handoff_in`` into an
+        ``initial_guess`` for run_scf.
+
+        Degrades rather than fails: a missing/partial artifact or one
+        whose shapes don't match this node's context gives a cold start
+        (mode ``"missing"``/``"cold"``); a corrupt one (non-finite
+        payload, campaign.handoff_corrupt fault site) is dropped with
+        mode ``"corrupt_fallback"``. Only a usable (rho, psi) pair
+        reaches run_scf, so the ValueError shape guard there — a
+        permanent-failure class — can never fire on handoff data."""
+        from sirius_tpu.campaigns import handoff as handoff_mod
+
+        path = job.handoff_in.get("path")
+        displaced = bool(job.handoff_in.get("displaced", True))
+        guess = None
+        try:
+            guess = handoff_mod.load_guess(path, ctx, displaced=displaced)
+            mode = "warm" if guess is not None else "missing"
+        except handoff_mod.HandoffError as e:
+            logger.warning("job %s: corrupt handoff artifact %s (%s); "
+                           "falling back to a cold start", job.id, path, e)
+            mode = "corrupt_fallback"
+        obs_events.emit("campaign_handoff", job_id=job.id,
+                        campaign_id=job.campaign_id, node_id=job.node_id,
+                        mode=mode, displaced=displaced)
+        return guess, mode
+
     def _run_job_inner(self, job: Job, slice_idx: int, devs,
                        epoch: int) -> None:
         import time as _time
@@ -229,7 +270,20 @@ class SliceScheduler:
 
         cfg = None
         try:
-            cfg = load_config(dict(job.deck))
+            if job.campaign_id:
+                # test/chaos hook: preempt a campaign node attempt before
+                # any SCF work (retries, then SKIPPED_UPSTREAM cascade)
+                faults.check("campaign.node_fail", job.attempts - 1)
+            deck = dict(job.deck)
+            task = deck.get("task") or "scf"
+            if job.handoff_in and job.handoff_in.get("adopt_positions"):
+                from sirius_tpu.campaigns import handoff as handoff_mod
+
+                # run at the geometry the parent settled on (relax->SCF
+                # chains); a missing artifact raises OSError = retryable
+                deck = handoff_mod.adopt_positions(
+                    deck, job.handoff_in["path"])
+            cfg = load_config(deck)
             job._cfg = cfg  # watchdog retries refresh the resume path
             # serve defaults: job-scoped autosaves with rotation so every
             # job is resumable and none clobbers a neighbour's checkpoint
@@ -255,17 +309,54 @@ class SliceScheduler:
                     max(0.0, _time.time() - job.submitted_at),
                     t0=job.submitted_at, slice=slice_idx,
                     bucket="warm" if warm else "cold")
+            guess = None
+            handoff_mode = None
+            if job.handoff_in:
+                guess, handoff_mode = self._load_handoff(job, ctx)
+            keep_state = bool(job.handoff_out)
             compiles0 = cache_mod.backend_compiles_this_thread()
             csec0 = obs_metrics.backend_compile_seconds_this_thread()
             t_run0 = _time.time()
+            final_positions = None
             with obs_spans.span("serve.run", slice=slice_idx,
                                 bucket="warm" if warm else "cold"):
                 with jax.default_device(devs[0]):
-                    result = run_scf(
-                        cfg, base_dir=job.base_dir, ctx=ctx,
-                        exec_cache=self.cache, devices=devs,
-                        resume=job.resume_path,
-                    )
+                    if task == "relax":
+                        from sirius_tpu.dft.relax import relax_atoms
+
+                        relax_args = (
+                            deck.get("relax")
+                            if isinstance(deck.get("relax"), dict) else {})
+                        rr = relax_atoms(
+                            cfg, base_dir=job.base_dir,
+                            max_steps=int(relax_args.get("max_steps", 30)),
+                            force_tol=float(
+                                relax_args.get("force_tol", 1e-4)),
+                            ctx=ctx, exec_cache=self.cache, devices=devs,
+                        )
+                        gs = rr["ground_state"]
+                        final_positions = rr["final_positions"]
+                        result = {
+                            "task": "relax",
+                            "converged": rr["converged"],
+                            "energy": gs["energy"],
+                            "num_scf_iterations": sum(
+                                h["scf_iterations"] for h in rr["history"]),
+                            "forces": gs.get("forces"),
+                            "_state": gs.get("_state"),
+                            "relax": {
+                                k: rr[k] for k in (
+                                    "converged", "num_steps", "history",
+                                    "final_positions")
+                            },
+                        }
+                    else:
+                        result = run_scf(
+                            cfg, base_dir=job.base_dir, ctx=ctx,
+                            exec_cache=self.cache, devices=devs,
+                            resume=job.resume_path,
+                            initial_guess=guess, keep_state=keep_state,
+                        )
             _RUN_SECONDS.observe(_time.time() - t_run0,
                                  bucket="warm" if warm else "cold",
                                  slice=slice_idx)
@@ -278,15 +369,31 @@ class SliceScheduler:
                 obs_spans.record("serve.compile", csec, slice=slice_idx,
                                  compiled_executables=compiled)
             counters["serve.backend_compiles"] += compiled
+            state = result.pop("_state", None)
             result["serve"] = {
                 "job_id": job.id,
                 "slice": slice_idx,
                 "attempts": job.attempts,
                 "bucket_warm": warm,
                 "compiled_executables": compiled,
+                "warm_start": guess is not None,
+                "handoff": handoff_mode,
             }
             if self._stale(job, epoch):
                 return
+            if job.handoff_out:
+                from sirius_tpu.campaigns import handoff as handoff_mod
+
+                # artifact before the terminal transition: a journaled
+                # DONE record must imply a durable artifact, or a replay
+                # could skip a node whose children have nothing to load
+                handoff_mod.save_artifact(
+                    job.handoff_out, ctx, result, state,
+                    positions=final_positions)
+            if job.campaign_id:
+                _NODE_ITERS.inc(
+                    int(result.get("num_scf_iterations") or 0),
+                    warm="true" if guess is not None else "false")
             job.result = result
             job._transition(
                 JobStatus.DONE,

@@ -28,6 +28,16 @@ Sites currently wired:
                      write, no newline, no fsync — the on-disk state a
                      crash inside write() leaves) — ``iteration`` is the
                      journal's append sequence number
+  campaign.node_fail fail a campaign node's attempt in the scheduler
+                     before its SCF starts (``raise`` preempts and
+                     retries; exhausting retries exercises the
+                     SKIPPED_UPSTREAM cascade to its children) —
+                     ``iteration`` is the job attempt index (0-based)
+  campaign.handoff_corrupt
+                     corrupt the parent-handoff density as the child
+                     loads it; the child must detect the damage and
+                     fall back to a cold start instead of failing
+                     (``iteration`` 0, fires once per armed count)
 
 Plans are process-local (``install``/``clear``) or inherited by child
 processes through the ``SIRIUS_TPU_FAULTS`` environment variable. The env
@@ -70,6 +80,8 @@ KNOWN_SITES = (
     "serve.worker_crash",
     "serve.job_hang",
     "serve.journal_torn",
+    "campaign.node_fail",
+    "campaign.handoff_corrupt",
 )
 
 

@@ -1,0 +1,586 @@
+"""sirius_tpu.campaigns: DAG spec validation, dependency-aware queue
+admission, cross-job warm-start handoff (ISSUE 10 acceptance), the phonon
+and EOS template finalizers against analytic models, and the engine-level
+SKIPPED_UPSTREAM / corrupt-handoff degradation paths."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sirius_tpu.campaigns import handoff
+from sirius_tpu.campaigns.eos import (
+    birch_murnaghan, eos_campaign, fit_birch_murnaghan,
+)
+from sirius_tpu.campaigns.phonon import node_id_for, phonon_campaign
+from sirius_tpu.campaigns.spec import (
+    CampaignNode, CampaignSpec, CampaignSpecError,
+)
+from sirius_tpu.config.schema import MixerConfig
+from sirius_tpu.dft.mixer import Mixer
+from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
+from sirius_tpu.utils import faults
+
+requires_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="needs the conftest virtual multi-device CPU mesh",
+)
+
+
+def _node(nid, parents=(), warm_from=None, **kw):
+    return CampaignNode(node_id=nid, deck={}, parents=list(parents),
+                        warm_from=warm_from, **kw)
+
+
+def _spec(*nodes, campaign_id="c"):
+    return CampaignSpec(campaign_id=campaign_id, nodes=list(nodes))
+
+
+# ------------------------------------------------------------- spec unit
+
+
+def test_spec_validates_clean_dag_and_topo_order():
+    spec = _spec(_node("a"), _node("b", ["a"], "a"), _node("c", ["a", "b"]))
+    spec.validate()
+    order = [n.node_id for n in spec.topo_order()]
+    assert order.index("a") < order.index("b") < order.index("c")
+
+
+def test_spec_rejects_cycle():
+    spec = _spec(_node("a", ["b"]), _node("b", ["a"]))
+    with pytest.raises(CampaignSpecError, match="cycle"):
+        spec.validate()
+
+
+def test_spec_rejects_duplicate_and_unknown_and_self():
+    with pytest.raises(CampaignSpecError, match="duplicate"):
+        _spec(_node("a"), _node("a")).validate()
+    with pytest.raises(CampaignSpecError, match="unknown parent"):
+        _spec(_node("a", ["ghost"])).validate()
+    with pytest.raises(CampaignSpecError, match="itself"):
+        _spec(_node("a", ["a"])).validate()
+
+
+def test_spec_rejects_warm_from_outside_parents():
+    with pytest.raises(CampaignSpecError, match="warm_from"):
+        _spec(_node("a"), _node("b"), _node("c", ["a"], "b")).validate()
+
+
+def test_spec_rejects_bad_ids_and_job_id_has_no_slash():
+    with pytest.raises(CampaignSpecError):
+        _spec(_node("bad/id")).validate()
+    with pytest.raises(CampaignSpecError):
+        CampaignSpec(campaign_id="has space", nodes=[_node("a")]).validate()
+    spec = _spec(_node("a"), campaign_id="ph.run-1")
+    # job ids become autosave-file tags: never a path separator
+    assert "/" not in spec.job_id("a")
+    assert spec.job_id("a") == "ph.run-1.a"
+
+
+def test_spec_dict_roundtrip():
+    spec = _spec(_node("a"), _node("b", ["a"], "a", displaced=False,
+                                   meta={"k": 1}))
+    spec.kind = "phonon"
+    back = CampaignSpec.from_dict(spec.to_dict())
+    assert back.kind == "phonon"
+    assert [n.node_id for n in back.nodes] == ["a", "b"]
+    assert back.node("b").warm_from == "a"
+    assert back.node("b").displaced is False
+    assert back.node("b").meta == {"k": 1}
+
+
+# ---------------------------------------------------------- queue DAG unit
+
+
+def test_queue_defers_child_until_parent_done():
+    q = JobQueue()
+    parent = Job({}, job_id="p")
+    child = Job({}, job_id="c", parents=["p"])
+    q.submit(child)  # child first: order must come from the DAG, not FIFO
+    q.submit(parent)
+    assert q.pop(timeout=0) is parent
+    assert q.pop(timeout=0.05) is None  # parent not terminal yet
+    parent._transition(JobStatus.DONE)
+    assert q.pop(timeout=0) is child
+
+
+def test_queue_unblocks_child_promptly_on_parent_terminal():
+    """The dependency wakeup is condition-based: a blocked pop() returns
+    the child within the parent's terminal transition, not after a poll
+    interval."""
+    q = JobQueue()
+    parent = Job({}, job_id="p")
+    child = Job({}, job_id="c", parents=["p"])
+    q.submit(parent)
+    q.submit(child)
+    assert q.pop(timeout=0) is parent
+    timer = threading.Timer(
+        0.25, lambda: parent._transition(JobStatus.DONE))
+    timer.start()
+    t0 = time.monotonic()
+    got = q.pop(timeout=10.0)
+    elapsed = time.monotonic() - t0
+    timer.join()
+    assert got is child
+    assert 0.2 <= elapsed < 2.0, f"unblock took {elapsed:.2f}s"
+
+
+def test_queue_skip_propagates_transitively():
+    q = JobQueue()
+    parent = Job({}, job_id="p")
+    child = Job({}, job_id="c", parents=["p"])
+    grand = Job({}, job_id="g", parents=["c"])
+    for j in (parent, child, grand):
+        q.submit(j)
+    assert q.pop(timeout=0) is parent
+    parent._transition(JobStatus.FAILED, "boom")
+    assert q.pop(timeout=0) is None
+    assert child.status == JobStatus.SKIPPED_UPSTREAM
+    assert grand.status == JobStatus.SKIPPED_UPSTREAM
+    assert "parent p" in child.events[-1][2]
+    assert "parent c" in grand.events[-1][2]
+
+
+def test_queue_external_parent_status_resolves_replayed_edges():
+    q = JobQueue()
+    q.external_parent_status["done-before"] = JobStatus.DONE
+    q.external_parent_status["failed-before"] = JobStatus.FAILED
+    ok = Job({}, job_id="ok", parents=["done-before"])
+    skip = Job({}, job_id="skip", parents=["failed-before"])
+    orphan = Job({}, job_id="orphan", parents=["never-journaled"])
+    for j in (ok, skip, orphan):
+        q.submit(j)
+    got = {q.pop(timeout=0).id for _ in range(2)}
+    # unknown parents resolve as satisfied: a half-replayed graph must
+    # not deadlock its children forever
+    assert got == {"ok", "orphan"}
+    assert q.pop(timeout=0) is None
+    assert skip.status == JobStatus.SKIPPED_UPSTREAM
+
+
+def test_engine_wait_all_wakes_within_terminal_transition():
+    """Regression for the condition-based wait_all: completion latency is
+    the transition itself, not a poll quantum."""
+    from sirius_tpu.serve.engine import ServeEngine
+
+    eng = ServeEngine(num_slices=1)  # never started: no workers
+    a = eng.submit({}, job_id="wa-a")
+    b = eng.submit({}, job_id="wa-b")
+    a._transition(JobStatus.DONE)
+    timer = threading.Timer(0.25, lambda: b._transition(JobStatus.DONE))
+    timer.start()
+    t0 = time.monotonic()
+    assert eng.wait_all(timeout=10.0)
+    elapsed = time.monotonic() - t0
+    timer.join()
+    assert 0.2 <= elapsed < 2.0, f"wait_all woke after {elapsed:.2f}s"
+    assert eng.wait_all(timeout=0.0)  # already-terminal: immediate True
+
+
+# ------------------------------------------------- handoff + mixer unit
+
+
+def test_uniform_translation_detects_rigid_shifts():
+    pos = np.array([[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]])
+    t = np.array([0.01, -0.02, 0.005])
+    out = handoff.uniform_translation(pos, pos + t)
+    assert out is not None and np.allclose(out, t, atol=1e-12)
+    # wrap across the cell boundary: fractional coords compare mod 1
+    wrapped = pos + t
+    wrapped[1] += [1.0, -1.0, 0.0]
+    assert handoff.uniform_translation(pos, wrapped) is not None
+    # non-uniform displacement is NOT a translation
+    non = pos.copy()
+    non[0] += [0.01, 0, 0]
+    assert handoff.uniform_translation(pos, non) is None
+    assert handoff.uniform_translation(pos, pos[:1]) is None
+    assert np.allclose(handoff.uniform_translation(pos, pos), 0.0)
+
+
+def _fixed_point_problem(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    lam = np.linspace(0.1, 0.95, n)
+    a = q @ np.diag(lam) @ q.T
+    b = rng.standard_normal(n)
+    return a, b, np.linalg.solve(np.eye(n) - a, b)
+
+
+def _solve(mixer, a, b, x0, tol=1e-10, iters=200):
+    x = x0.copy()
+    for i in range(iters):
+        f = a @ x + b - x
+        if np.linalg.norm(f) < tol:
+            return i
+        x = mixer.mix(x, a @ x + b)
+    return iters
+
+
+def test_mixer_import_secants_transfers_jacobian_info():
+    """Secant pairs from a donor run on the SAME linear map accelerate
+    the child; the pairs are anchored at the child's first residual."""
+    a, b, _ = _fixed_point_problem()
+    donor = Mixer(MixerConfig(type="anderson", beta=0.6, max_history=8))
+    _solve(donor, a, b, np.zeros_like(b))
+    hist = donor.export_history()
+    # child: same Jacobian, different affine part -> different fixed point
+    b2 = b + 0.3 * np.ones_like(b)
+    cold = _solve(Mixer(MixerConfig(type="anderson", beta=0.6,
+                                    max_history=8)),
+                  a, b2, np.zeros_like(b))
+    warm_mixer = Mixer(MixerConfig(type="anderson", beta=0.6, max_history=8))
+    warm_mixer.import_secants(np.diff(hist["mix_x"], axis=0),
+                              np.diff(hist["mix_f"], axis=0))
+    warm = _solve(warm_mixer, a, b2, np.zeros_like(b))
+    assert warm < cold, (warm, cold)
+
+
+def test_mixer_import_secants_anchors_at_first_residual():
+    m = Mixer(MixerConfig(type="anderson", beta=0.5, max_history=8))
+    dx = np.array([1.0, 0.0, 0.0])
+    df = np.array([0.0, 2.0, 0.0])
+    m.import_secants([dx], [df])
+    x_in = np.array([5.0, 5.0, 5.0])
+    x_out = np.array([5.0, 6.0, 5.0])
+    m.mix(x_in, x_out)
+    # (x_in - dx, f - df): the difference-to-current block is exactly the
+    # imported secant
+    assert np.allclose(m._x[0], x_in - dx)
+    assert np.allclose(m._f[0], (x_out - x_in) - df)
+
+
+def test_mixer_flush_drops_pending_secants():
+    m = Mixer(MixerConfig(type="anderson", beta=0.5, max_history=8))
+    m.import_secants([np.ones(3)], [np.ones(3)])
+    m.flush_history()
+    x_in = np.zeros(3)
+    x_out = np.array([1.0, 1.0, 1.0])
+    out = m.mix(x_in, x_out)
+    # no history survived: first mix degrades to the plain damped step
+    assert np.allclose(out, x_in + 0.5 * (x_out - x_in))
+
+
+# -------------------------------------------------------- phonon template
+
+
+def test_phonon_template_wires_translation_equivalent_nodes():
+    from tests.test_serve import make_deck
+
+    spec = phonon_campaign(make_deck(), displacement=0.01,
+                           campaign_id="ph")
+    spec.validate()
+    assert len(spec.nodes) == 13
+    # atom-0 nodes warm from the base; each atom-1 node is the rigid
+    # translation of the opposite-sign atom-0 node and warms from it
+    for i in range(3):
+        assert spec.node(node_id_for(0, i, +1)).warm_from == "base"
+        assert (spec.node(node_id_for(1, i, +1)).warm_from
+                == node_id_for(0, i, -1))
+        assert (spec.node(node_id_for(1, i, -1)).warm_from
+                == node_id_for(0, i, +1))
+    for n in spec.nodes[1:]:
+        assert n.displaced
+        assert n.warm_from in n.parents
+
+
+def test_phonon_finalize_recovers_analytic_spring_frequencies():
+    """Forces generated from an exact harmonic model F = -C u: central
+    differences recover C exactly and the frequencies match the
+    analytically diagonalized mass-weighted matrix."""
+    from sirius_tpu.campaigns.phonon import HA_TO_CM1, finalize
+    from sirius_tpu.md.integrator import AMU_TO_AU
+
+    rng = np.random.default_rng(1)
+    k = rng.standard_normal((3, 3))
+    k = k @ k.T + 3.0 * np.eye(3)  # SPD spring tensor
+    c_true = np.block([[k, -k], [-k, k]])  # 2 atoms, one spring, ASR-exact
+    h = 0.01
+    masses = np.array([28.0, 28.0])
+    spec = CampaignSpec(campaign_id="an", kind="phonon", nodes=[
+        CampaignNode(node_id="base", deck={})],
+        meta={"displacement": h, "natoms": 2, "atoms": [0, 1]})
+    arts = {"base": {
+        "positions": np.array([[0.0, 0, 0], [0.25, 0.25, 0.25]]),
+        "masses_amu": masses, "energy_total": -8.0}}
+    for a in (0, 1):
+        for i in range(3):
+            for s in (+1, -1):
+                u = np.zeros(6)
+                u[3 * a + i] = s * h  # cartesian displacement
+                arts[node_id_for(a, i, s)] = {
+                    "forces": (-c_true @ u).reshape(2, 3)}
+    out = finalize(spec, arts)
+    m_au = masses * AMU_TO_AU
+    sqrt_m = np.sqrt(np.repeat(m_au, 3))
+    evals = np.linalg.eigvalsh(c_true / np.outer(sqrt_m, sqrt_m))
+    want = np.sign(evals) * np.sqrt(np.abs(evals)) * HA_TO_CM1
+    got = np.asarray(out["frequencies_cm1"])
+    assert np.allclose(got, want, atol=1e-6 * np.max(np.abs(want)))
+    assert out["num_acoustic_near_zero"] == 3
+    assert out["asr_violation_ha_bohr2"] < 1e-12
+
+
+def test_phonon_finalize_requires_all_forces():
+    from sirius_tpu.campaigns.phonon import finalize
+    from tests.test_serve import make_deck
+
+    spec = phonon_campaign(make_deck(), campaign_id="ph")
+    with pytest.raises(ValueError, match="base node artifact missing"):
+        finalize(spec, {})
+    arts = {"base": {"positions": np.zeros((2, 3)),
+                     "masses_amu": np.array([28.0, 28.0]),
+                     "energy_total": -8.0}}
+    with pytest.raises(ValueError, match="no forces"):
+        finalize(spec, arts)
+
+
+# ----------------------------------------------------------- EOS template
+
+
+def test_eos_campaign_nodes_are_independent():
+    from tests.test_serve import make_deck
+
+    spec = eos_campaign(make_deck(), num_points=5, campaign_id="eos")
+    spec.validate()
+    assert len(spec.nodes) == 5
+    # a volume change changes the G sets: nothing to warm-start across
+    assert all(not n.parents and n.warm_from is None for n in spec.nodes)
+    with pytest.raises(CampaignSpecError, match="4 parameters"):
+        eos_campaign(make_deck(), num_points=3)
+    with pytest.raises(CampaignSpecError, match="scale0"):
+        eos_campaign(make_deck(), scale0=1.1, scale1=0.9)
+
+
+def test_eos_fit_recovers_known_parameters_and_tolerates_holes():
+    from sirius_tpu.campaigns.eos import finalize
+    from tests.test_serve import make_deck
+
+    e0, v0, b0, b0p = -8.2, 270.0, 0.003, 4.2
+    spec = eos_campaign(make_deck(), num_points=7, campaign_id="eos")
+    arts = {
+        n.node_id: {"energy_total": float(birch_murnaghan(
+            n.meta["volume_bohr3"], e0, v0, b0, b0p))}
+        for n in spec.nodes
+    }
+    fit = finalize(spec, arts)
+    assert abs(fit["v0_bohr3"] - v0) < 1e-6
+    assert abs(fit["b0_ha_bohr3"] - b0) < 1e-9
+    assert abs(fit["e0_ha"] - e0) < 1e-12
+    assert fit["fit_rms_ha"] < 1e-12
+    # a failed node leaves a hole; >= 4 surviving points still fit
+    arts_holey = dict(arts)
+    del arts_holey["v3"]
+    assert finalize(spec, arts_holey)["num_points"] == 6
+    for nid in ("v1", "v2", "v4"):
+        del arts_holey[nid]
+    with pytest.raises(ValueError, match="not enough"):
+        finalize(spec, arts_holey)
+
+
+def test_eos_fit_rejects_non_convex_sweep():
+    v = np.array([100.0, 110, 120, 130])
+    with pytest.raises(ValueError, match="convex"):
+        fit_birch_murnaghan(v, -((v - 115.0) ** 2))  # concave: a maximum
+
+
+# -------------------------------------------------- lint registry coverage
+
+
+def test_campaign_fault_sites_are_registered():
+    assert "campaign.node_fail" in faults.KNOWN_SITES
+    assert "campaign.handoff_corrupt" in faults.KNOWN_SITES
+
+
+def test_campaign_spans_match_lint_grammar():
+    from sirius_tpu.analysis.registryrules import _SPAN_RE
+
+    assert _SPAN_RE.match("campaign.finalize")
+    assert _SPAN_RE.match("campaign.handoff")
+    assert not _SPAN_RE.match("campaigns.finalize")
+
+
+# ------------------------------------ warm-start handoff (host SCF, slow-ish)
+
+
+DECK = dict(
+    gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+    ultrasoft=True, use_symmetry=False,
+    extra_params={"num_dft_iter": 40, "density_tol": 5e-9,
+                  "energy_tol": 1e-10},
+)
+
+BASE_POS = np.array([[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]])
+LATTICE = 10.26 / 2 * np.array([[0.0, 1, 1], [1, 0, 1], [1, 1, 0]])
+DFRAC = 0.01 * np.linalg.inv(LATTICE)[0]  # 0.01 bohr along cartesian x
+
+
+def _run(positions, guess=None, keep_state=False):
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(positions=positions, **DECK)
+    res = run_scf(ctx.cfg, ctx=ctx, initial_guess=guess,
+                  keep_state=keep_state)
+    assert res["converged"]
+    return ctx, res
+
+
+@pytest.fixture(scope="module")
+def base_artifact(tmp_path_factory):
+    ctx, res = _run(BASE_POS, keep_state=True)
+    path = str(tmp_path_factory.mktemp("ho") / "handoff.t.base.npz")
+    handoff.save_artifact(path, ctx, res, res["_state"])
+    return path, res
+
+
+def test_handoff_same_geometry_same_energy_fewer_iterations(base_artifact):
+    path, base_res = base_artifact
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(positions=BASE_POS, **DECK)
+    guess = handoff.load_guess(path, ctx, displaced=True)
+    assert guess is not None
+    _, warm = _run(BASE_POS, guess=guess)
+    assert warm["num_scf_iterations"] < base_res["num_scf_iterations"]
+    assert abs(warm["energy"]["total"]
+               - base_res["energy"]["total"]) <= 1e-10
+
+
+def test_handoff_displaced_delta_density_and_translation(base_artifact):
+    """The two displaced warm-start routes: the QE-style delta-density
+    transform against a cold run at the same displaced geometry, then the
+    exact phase-twist for a translation-equivalent geometry (the phonon
+    template's d1* <- d0* edges)."""
+    path, _ = base_artifact
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    pos_d0xm = BASE_POS.copy()
+    pos_d0xm[0] -= DFRAC
+    _, cold = _run(pos_d0xm)
+
+    ctx = synthetic_silicon_context(positions=pos_d0xm, **DECK)
+    guess = handoff.load_guess(path, ctx, displaced=True)
+    assert guess is not None
+    ctx_w, warm = _run(pos_d0xm, guess=guess, keep_state=True)
+    assert warm["num_scf_iterations"] < cold["num_scf_iterations"]
+    assert abs(warm["energy"]["total"] - cold["energy"]["total"]) <= 1e-9
+
+    # displacing atom 1 by +h is the rigid translation of displacing
+    # atom 0 by -h: the twisted parent fields are already the fixed point
+    import os
+    path_d0xm = os.path.join(os.path.dirname(path), "handoff.t.d0xm.npz")
+    handoff.save_artifact(path_d0xm, ctx_w, warm, warm["_state"])
+    pos_d1xp = BASE_POS.copy()
+    pos_d1xp[1] += DFRAC
+    assert handoff.uniform_translation(pos_d0xm, pos_d1xp) is not None
+    ctx_t = synthetic_silicon_context(positions=pos_d1xp, **DECK)
+    guess_t = handoff.load_guess(path_d0xm, ctx_t, displaced=True)
+    assert guess_t is not None
+    assert guess_t[2] is None  # translated guess suppresses the hint
+    _, trans = _run(pos_d1xp, guess=guess_t)
+    assert trans["num_scf_iterations"] <= 4
+    assert abs(trans["energy"]["total"] - cold["energy"]["total"]) <= 1e-9
+
+
+def test_handoff_shape_mismatch_degrades_to_cold_start(base_artifact):
+    """An EOS-style parent (different volume, different G set) must give
+    None (cold start), never reach run_scf's ValueError shape guard."""
+    path, _ = base_artifact
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx_small = synthetic_silicon_context(
+        positions=BASE_POS, **{**DECK, "gk_cutoff": 2.5, "pw_cutoff": 6.0})
+    assert handoff.load_guess(path, ctx_small, displaced=True) is None
+
+
+def test_handoff_corrupt_raises_handoff_error(base_artifact):
+    path, _ = base_artifact
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(positions=BASE_POS, **DECK)
+    faults.install([("campaign.handoff_corrupt", 0, "nan")])
+    try:
+        with pytest.raises(handoff.HandoffError, match="non-finite"):
+            handoff.load_guess(path, ctx, displaced=True)
+        assert faults.fired() == [("campaign.handoff_corrupt", 0, "nan")]
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------- engine integration (fused path)
+
+
+@requires_mesh
+@pytest.mark.faults
+def test_campaign_node_fail_cascades_to_skipped_upstream(tmp_path):
+    """Exhausting a root node's retries must terminally skip the whole
+    subtree without running any SCF, and the campaign still reports."""
+    from sirius_tpu.campaigns import runner
+    from sirius_tpu.serve.engine import ServeEngine
+    from tests.test_serve import make_deck
+
+    spec = CampaignSpec(campaign_id="skipc", kind="generic", nodes=[
+        _node("root"),
+        _node("mid", ["root"], "root"),
+        _node("leaf", ["mid"], "mid"),
+    ])
+    for n in spec.nodes:
+        n.deck = make_deck()
+    # default max_retries=2 -> 3 attempts, all preempted before SCF
+    faults.install([("campaign.node_fail", i, "raise") for i in range(3)])
+    eng = ServeEngine(num_slices=1, devices=jax.devices()[:2],
+                      workdir=str(tmp_path))
+    eng.start()
+    try:
+        handle = runner.submit_campaign(eng, spec, workdir=str(tmp_path))
+        assert eng.wait_all(timeout=120.0)
+    finally:
+        eng.shutdown(wait=True)
+        faults.clear()
+    assert handle.jobs["root"].status == JobStatus.FAILED
+    assert handle.jobs["mid"].status == JobStatus.SKIPPED_UPSTREAM
+    assert handle.jobs["leaf"].status == JobStatus.SKIPPED_UPSTREAM
+    st = handle.status()
+    assert st["num_terminal"] == 3 and st["num_done"] == 0
+    res = handle.result()
+    assert res["summary"]["energies_ha"] == {}  # nothing ever converged
+    assert res["scf_iterations"] == {}
+
+
+@requires_mesh
+@pytest.mark.faults
+def test_campaign_corrupt_handoff_falls_back_cold_and_completes(tmp_path):
+    """campaign.handoff_corrupt poisons the artifact as the child loads
+    it: the child must detect the damage, cold-start, and still end DONE
+    with the same energy (same geometry, corruption only cost warmth)."""
+    from sirius_tpu.campaigns import runner
+    from sirius_tpu.serve.engine import ServeEngine
+    from tests.test_serve import make_deck
+
+    spec = CampaignSpec(campaign_id="corrc", kind="generic", nodes=[
+        _node("parent"), _node("kid", ["parent"], "parent",
+                               displaced=False)])
+    for n in spec.nodes:
+        n.deck = make_deck()
+    faults.install([("campaign.handoff_corrupt", 0, "nan")])
+    eng = ServeEngine(num_slices=1, devices=jax.devices()[:2],
+                      workdir=str(tmp_path))
+    eng.start()
+    try:
+        handle = runner.submit_campaign(eng, spec, workdir=str(tmp_path))
+        assert eng.wait_all(timeout=900.0)
+        fired = faults.fired()
+    finally:
+        eng.shutdown(wait=True)
+        faults.clear()
+    assert handle.jobs["parent"].status == JobStatus.DONE
+    assert handle.jobs["kid"].status == JobStatus.DONE, (
+        handle.jobs["kid"].error)
+    assert ("campaign.handoff_corrupt", 0, "nan") in fired
+    e_p = handle.jobs["parent"].result["energy"]["total"]
+    e_k = handle.jobs["kid"].result["energy"]["total"]
+    assert abs(e_p - e_k) <= 1e-10
+    summary = handle.finalize()
+    assert set(summary["energies_ha"]) == {"parent", "kid"}

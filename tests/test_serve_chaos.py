@@ -56,7 +56,8 @@ def test_journal_roundtrip_replays_only_non_terminal(tmp_path):
     assert [r["job_id"] for r in pending] == ["a"]
     assert pending[0]["priority"] == 3
     assert pending[0]["base_dir"] == str(tmp_path)
-    assert stats == {"submits": 2, "terminals": 1, "torn_lines": 0}
+    assert stats == {"submits": 2, "terminals": 1, "torn_lines": 0,
+                     "terminal_status": {"b": JobStatus.DONE}}
 
 
 def test_journal_replay_missing_file_is_empty(tmp_path):

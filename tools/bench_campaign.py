@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Campaign warm-start benchmark -> CAMPAIGN_BENCH.json.
+
+Runs the first-consumer campaign end-to-end: a Γ-point finite-displacement
+phonon DAG on the tier-1 synthetic-Si deck (13 nodes for the 2-atom cell —
+base + 12 displaced, every displaced node warm-started from the base
+node's converged (rho, psi) through the cross-job handoff), then the same
+13 decks again as independent jobs with no handoff. The artifact records
+both iteration totals and the phonon summary, and the run FAILS unless
+
+  * every campaign node reaches DONE and the finalizer produces the six
+    Γ frequencies,
+  * the warm campaign spends >= --min-iter-savings (default 30%) fewer
+    total SCF iterations than the independent reference, and
+  * >= --min-hit-rate (default 0.9) of the campaign's nodes land in a
+    warm executable-cache bucket (the DAG family shares one padded
+    shape bucket, so only the base node should compile).
+
+Usage:
+    python tools/bench_campaign.py [--slices S] [--out CAMPAIGN_BENCH.json]
+
+Exit status 0 = all assertions above hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def make_deck(device_scf: str = "auto") -> dict:
+    """The tier-1 synthetic-Si deck (loadgen family) in cli.py JSON form."""
+    return {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": [1, 1, 1],
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": 60,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+        "control": {
+            "device_scf": device_scf,
+            "ngk_pad_quantum": 16,
+        },
+        "synthetic": {
+            "ultrasoft": True,
+            "positions": [[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual CPU device count (0 = leave platform "
+                         "as-is); >1 per slice keeps the fused path on")
+    ap.add_argument("--displacement", type=float, default=0.01,
+                    help="finite displacement in bohr")
+    ap.add_argument("--device-scf", default="auto",
+                    choices=["auto", "on", "off"])
+    ap.add_argument("--min-iter-savings", type=float, default=0.30,
+                    help="required fractional SCF-iteration cut, warm "
+                         "campaign vs independent jobs")
+    ap.add_argument("--min-hit-rate", type=float, default=0.9,
+                    help="required warm-bucket fraction across the "
+                         "campaign's nodes")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "CAMPAIGN_BENCH.json"))
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if args.devices > 1 and "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
+    import tempfile
+
+    from sirius_tpu.campaigns import runner
+    from sirius_tpu.campaigns.phonon import phonon_campaign
+    from sirius_tpu.serve.engine import ServeEngine
+    from sirius_tpu.serve.queue import JobStatus
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sirius_campaign_")
+    spec = phonon_campaign(make_deck(args.device_scf),
+                           displacement=args.displacement,
+                           campaign_id="bench")
+    eng = ServeEngine(num_slices=args.slices, workdir=workdir, verbose=True,
+                      events_path=os.path.join(workdir, "events.jsonl"))
+    eng.start()
+
+    t0 = time.time()
+    handle = runner.submit_campaign(eng, spec, workdir=workdir)
+    handle.wait(timeout=3600.0)
+    campaign_res = handle.result()
+    campaign_wall = time.time() - t0
+
+    per_node = {}
+    for nid, job in handle.jobs.items():
+        r = job.result if isinstance(job.result, dict) else {}
+        per_node[nid] = {
+            "status": job.status,
+            "iterations": r.get("num_scf_iterations"),
+            "warm_start": (r.get("serve") or {}).get("warm_start"),
+            "bucket_warm": (r.get("serve") or {}).get("bucket_warm"),
+            "energy_ha": (r.get("energy") or {}).get("total"),
+        }
+    all_done = all(v["status"] == JobStatus.DONE for v in per_node.values())
+    warm_iters = sum(int(v["iterations"] or 0) for v in per_node.values())
+    warm_buckets = sum(bool(v["bucket_warm"]) for v in per_node.values())
+    hit_rate = warm_buckets / max(len(per_node), 1)
+
+    # independent reference: the identical 13 decks with no DAG and no
+    # handoff — every job builds its own density from the atomic guess
+    t1 = time.time()
+    ind_jobs = [eng.submit(node.deck, job_id=f"ind-{node.node_id}")
+                for node in spec.nodes]
+    eng.wait_all(timeout=3600.0)
+    ind_wall = time.time() - t1
+    ind_iters = sum(
+        int(j.result.get("num_scf_iterations") or 0)
+        for j in ind_jobs if isinstance(j.result, dict))
+    ind_done = sum(j.status == JobStatus.DONE for j in ind_jobs)
+
+    obs_snap = eng.metrics_snapshot()
+    eng.shutdown(wait=True)
+
+    savings = 1.0 - warm_iters / ind_iters if ind_iters else 0.0
+    summary = campaign_res.get("summary") or {}
+    freqs = summary.get("frequencies_cm1") or []
+    ok = (all_done and ind_done == len(spec.nodes)
+          and summary.get("kind") == "phonon" and len(freqs) == 6
+          and savings >= args.min_iter_savings
+          and hit_rate >= args.min_hit_rate)
+
+    bench = {
+        "bench": "campaign_phonon",
+        "deck": "synthetic-Si gk=3.0 pw=7.0 nb=8 (tier-1), "
+                f"displacement={args.displacement} bohr",
+        "num_nodes": len(spec.nodes),
+        "all_done": all_done,
+        "campaign_scf_iterations": warm_iters,
+        "independent_scf_iterations": ind_iters,
+        "iter_savings": savings,
+        "min_iter_savings": args.min_iter_savings,
+        "bucket_hit_rate": hit_rate,
+        "min_hit_rate": args.min_hit_rate,
+        "campaign_wall_s": campaign_wall,
+        "independent_wall_s": ind_wall,
+        "phonon": summary,
+        "per_node": per_node,
+        "campaign_node_scf_iterations_total": obs_snap["registry"].get(
+            "campaign_node_scf_iterations_total", {}).get("samples", []),
+        "cache": eng.stats()["cache"],
+        "events_log": os.path.join(workdir, "events.jsonl"),
+        "ok": ok,
+    }
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=2, default=float)
+    print(json.dumps({k: v for k, v in bench.items() if k != "per_node"},
+                     indent=2, default=float))
+    print(f"wrote {args.out} (ok={ok})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,6 +24,12 @@ decks, host SCF path) and attacks it the way production does:
   torn_tail       the journal's final append is torn mid-line
                   (serve.journal_torn); replay must repair the tail, count
                   the torn line, and re-run the un-acknowledged job.
+  campaign_kill   SIGKILL a 13-node phonon campaign DAG mid-flight (with a
+                  campaign.node_fail preemption thrown in); a restart on
+                  the same journal must replay exactly the unfinished
+                  nodes with their dependency edges intact, leave the
+                  completed nodes untouched, and finalize real Γ
+                  frequencies from the handoff artifacts on disk.
 
 Usage:
     python tools/chaos_serve.py [--phases a,b,...] [--out CHAOS_BENCH.json]
@@ -47,7 +53,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
-TERMINAL = ("done", "failed", "aborted")
+TERMINAL = ("done", "failed", "aborted", "skipped_upstream")
 
 
 def make_deck(seed: int = 0, device_scf: str = "off") -> dict:
@@ -167,7 +173,19 @@ def child_main(args) -> int:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     eng.start()
-    if args.mode == "submit":
+    handle = None
+    if args.mode in ("campaign", "campaign_resume"):
+        from sirius_tpu.campaigns import runner as campaign_runner
+        from sirius_tpu.campaigns.phonon import phonon_campaign
+
+        # deterministic spec: both the first life and the resume rebuild
+        # the identical DAG, so node job-ids line up with the journal
+        spec = phonon_campaign(make_deck(0), campaign_id="chaosph")
+        if args.mode == "campaign":
+            handle = campaign_runner.submit_campaign(eng, spec, workdir=wd)
+        else:
+            handle = campaign_runner.resume_campaign(eng, spec, workdir=wd)
+    elif args.mode == "submit":
         for i in range(args.jobs):
             # --budget-first scopes the wall-time budget to job 0 (the
             # designated poison job); a budget tight enough to catch an
@@ -192,6 +210,8 @@ def child_main(args) -> int:
         "jobs": [j.to_dict() for j in eng._submitted],
         "faults_fired": faults.fired(),
     }
+    if handle is not None:
+        result["campaign"] = handle.result()
     with open(os.path.join(wd, f"result-{args.mode}.json"), "w") as f:
         json.dump(result, f, indent=2, default=float)
     all_terminal = all(j.terminal for j in eng._submitted)
@@ -425,15 +445,66 @@ def phase_torn_tail(root: str) -> dict:
             "pending_after_restart": len(final["pending"])}
 
 
+def phase_campaign_kill(root: str, slices: int) -> dict:
+    """SIGKILL a phonon campaign DAG mid-flight; the restart must replay
+    exactly the unfinished nodes (edges intact, completed nodes left
+    alone) and finalize Γ frequencies from the artifacts on disk. A
+    campaign.node_fail preemption on the very first attempt also checks
+    the retry path inside a campaign."""
+    wd = os.path.join(root, "campaign")
+    os.makedirs(wd, exist_ok=True)
+    jp = os.path.join(wd, "jobs.journal")
+    events = os.path.join(wd, "events.jsonl")
+    proc = spawn_child(wd, "campaign", 0, slices,
+                       faults="campaign.node_fail@0:raise")
+    # kill only once the DAG is genuinely mid-flight: the base node (and
+    # at least one displaced child) done, more children still pending
+    armed = wait_for(
+        lambda: (lambda js: len(js["terminal"]) >= 2 and js["pending"])(
+            journal_state(jp)),
+        timeout=240.0)
+    proc.send_signal(signal.SIGKILL)
+    rc_kill = proc.wait()
+    mid = journal_state(jp)
+    rc_resume = run_child(wd, "campaign_resume", 0, slices)
+    final = journal_state(jp)
+    res = read_json(os.path.join(wd, "result-campaign_resume.json"))
+    camp = res.get("campaign") or {}
+    statuses = camp.get("nodes") or {}
+    summary = camp.get("summary") or {}
+    replays = count_events(events, "journal_replay_job")
+    preempts = [e for e in events_of(events, "backoff")
+                if e.get("failure_class") == "preempted"]
+    freqs = summary.get("frequencies_cm1") or []
+    ok = (armed and rc_kill == -signal.SIGKILL and rc_resume == 0
+          and len(final["submitted"]) == 13 and not final["pending"]
+          and replays == len(mid["pending"]) > 0
+          and len(mid["terminal"]) >= 2
+          and statuses and all(s == "done" for s in statuses.values())
+          and summary.get("kind") == "phonon" and len(freqs) == 6
+          and len(preempts) >= 1)
+    return {"ok": ok, "rc_kill": rc_kill, "rc_resume": rc_resume,
+            "nodes": len(final["submitted"]),
+            "terminal_at_kill": len(mid["terminal"]),
+            "pending_at_kill": len(mid["pending"]), "replayed": replays,
+            "pending_after_restart": len(final["pending"]),
+            "node_statuses": statuses,
+            "node_fail_preemptions": len(preempts),
+            "frequencies_cm1": freqs,
+            "finalize_error": camp.get("finalize_error")}
+
+
 PHASES = ("kill_restart", "crash_respawn", "hang_quarantine",
-          "drain_restart", "backoff", "torn_tail")
+          "drain_restart", "backoff", "torn_tail", "campaign_kill")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", action="store_true",
                     help=argparse.SUPPRESS)
-    ap.add_argument("--mode", choices=["submit", "resume"], default="submit")
+    ap.add_argument("--mode", default="submit",
+                    choices=["submit", "resume", "campaign",
+                             "campaign_resume"])
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--jobs", type=int, default=4)
     ap.add_argument("--slices", type=int, default=2)
@@ -482,6 +553,8 @@ def main(argv=None) -> int:
             res = phase_drain_restart(root)
         elif name == "backoff":
             res = phase_backoff(root)
+        elif name == "campaign_kill":
+            res = phase_campaign_kill(root, args.slices)
         else:
             res = phase_torn_tail(root)
         res["wall_s"] = time.time() - tp

@@ -9,8 +9,15 @@ cache-shared) plus a second k-mesh variant (a second bucket). Padded
 shapes + the executable cache mean only the first job of each bucket
 compiles.
 
+``--mix campaigns`` additionally runs a Γ-phonon campaign DAG
+(sirius_tpu.campaigns) concurrently with the single-job traffic, and the
+artifact reports submit-to-terminal latency per class (``single`` vs
+``campaign_node`` — campaign nodes queue behind their dependency edges,
+so their latency distribution is the interesting one).
+
 Usage:
-    python tools/loadgen.py [--jobs N] [--slices S] [--out SERVE_BENCH.json]
+    python tools/loadgen.py [--jobs N] [--slices S] [--mix campaigns]
+                            [--out SERVE_BENCH.json]
 
 Exit status 0 = every job converged.
 """
@@ -80,6 +87,25 @@ OBS_WHITELIST = (
 )
 
 
+def latency_summary(jobs) -> dict:
+    """Submit-to-terminal latency stats for one job class."""
+    lats = sorted(j.latency for j in jobs
+                  if j.latency is not None and j.status == "done")
+
+    def pct(p):
+        if not lats:
+            return None
+        k = min(len(lats) - 1, max(0, int(round(p / 100 * (len(lats) - 1)))))
+        return lats[k]
+
+    return {
+        "count": len(lats),
+        "p50_s": pct(50),
+        "p95_s": pct(95),
+        "mean_s": (sum(lats) / len(lats)) if lats else None,
+    }
+
+
 def summarize_registry(registry: dict, whitelist=OBS_WHITELIST) -> dict:
     """Condense a metrics snapshot for the JSON artifact: whitelisted
     families only, histograms reduced to {labels, count, sum} (bucket
@@ -106,6 +132,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jobs", type=int, default=8)
     ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--mix", default="decks", choices=["decks", "campaigns"],
+                    help="decks: independent deck family only; campaigns: "
+                         "the same family plus a concurrent Γ-phonon "
+                         "campaign DAG, with per-class latency reported")
     ap.add_argument("--devices", type=int, default=4,
                     help="virtual CPU device count (0 = leave platform as-is);"
                          " >1 per slice keeps the fused/exec-cache path on")
@@ -134,6 +164,15 @@ def main(argv=None) -> int:
     eng = ServeEngine(num_slices=args.slices, workdir=workdir, verbose=True,
                       events_path=os.path.join(workdir, "events.jsonl"))
     eng.start()
+    handle = None
+    if args.mix == "campaigns":
+        from sirius_tpu.campaigns import runner as campaign_runner
+        from sirius_tpu.campaigns.phonon import phonon_campaign
+
+        spec = phonon_campaign(
+            make_deck(positions=[[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]]),
+            campaign_id="lg")
+        handle = campaign_runner.submit_campaign(eng, spec, workdir=workdir)
     for i, deck in enumerate(deck_mix(args.jobs)):
         eng.submit(deck, job_id=f"lg-{i}")
     ok = eng.wait_all(timeout=3600.0)
@@ -142,8 +181,11 @@ def main(argv=None) -> int:
     eng.shutdown(wait=True)
 
     stats = eng.stats()
+    singles = [j for j in eng._submitted if j.campaign_id is None]
+    nodes = [j for j in eng._submitted if j.campaign_id is not None]
     bench = {
         "bench": "serve_loadgen",
+        "mix": args.mix,
         "deck": "synthetic-Si gk=3.0 pw=7.0 nb=8 (tier-1 mix)",
         "num_jobs": stats["num_jobs"],
         "num_done": stats["num_done"],
@@ -153,6 +195,10 @@ def main(argv=None) -> int:
         "jobs_per_min": stats["jobs_per_min"],
         "p50_latency_s": stats["p50_latency_s"],
         "p95_latency_s": stats["p95_latency_s"],
+        "per_class_latency": {
+            "single": latency_summary(singles),
+            "campaign_node": latency_summary(nodes),
+        },
         "cache_hit_rate": stats["cache"]["hit_rate"],
         "cache": stats["cache"],
         "retries_total": stats["retries_total"],
@@ -172,6 +218,13 @@ def main(argv=None) -> int:
         "events_log": os.path.join(workdir, "events.jsonl"),
         "per_job": [j.to_dict() for j in eng._submitted],
     }
+    if handle is not None:
+        camp = handle.result()
+        bench["campaign"] = {k: camp.get(k) for k in (
+            "campaign_id", "kind", "num_nodes", "num_done",
+            "scf_iterations", "finalize_error")}
+        bench["campaign"]["summary_kind"] = (
+            (camp.get("summary") or {}).get("kind"))
     with open(args.out, "w") as f:
         json.dump(bench, f, indent=2, default=float)
     print(json.dumps({k: v for k, v in bench.items() if k != "per_job"},
