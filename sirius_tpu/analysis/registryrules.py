@@ -24,7 +24,8 @@ from sirius_tpu.analysis.core import (
     dotted_name,
 )
 
-_SPAN_RE = re.compile(r"^(scf|md|serve|campaign)\.[a-z_][a-z0-9_.]*$")
+_SPAN_RE = re.compile(
+    r"^(scf|md|serve|campaign|trace|collective)\.[a-z_][a-z0-9_.]*$")
 
 
 @dataclasses.dataclass

@@ -36,6 +36,7 @@ import os
 
 import numpy as np
 
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger
 from sirius_tpu.utils import faults
 
@@ -85,6 +86,12 @@ def save_artifact(path: str, ctx, result: dict, state: dict | None = None,
         "num_scf_iterations": np.int64(summary["num_scf_iterations"]),
         "summary_json": np.str_(json.dumps(summary, default=float)),
     }
+    # the campaign's trace rides in the artifact so a child job loaded in
+    # a FRESH process (resume after SIGKILL) can continue the parent's
+    # end-to-end trace (obs/tracing.py)
+    tid = obs_tracing.current_trace_id()
+    if tid is not None:
+        arrs["trace_id"] = np.str_(tid)
     forces = result.get("forces")
     if isinstance(forces, dict):
         forces = forces.get("total")
@@ -128,6 +135,20 @@ def save_artifact(path: str, ctx, result: dict, state: dict | None = None,
             except OSError:
                 pass
     return path
+
+
+def artifact_trace_id(path: str) -> str | None:
+    """Just the trace_id stored in an artifact (None when absent) —
+    cheap: npz members load lazily, the arrays stay on disk."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            if "trace_id" in data.files:
+                return str(data["trace_id"])
+    except Exception:
+        return None
+    return None
 
 
 def load_artifact(path: str) -> dict | None:

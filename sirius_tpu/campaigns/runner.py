@@ -30,6 +30,7 @@ from sirius_tpu.campaigns.spec import CampaignSpec
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import spans as obs_spans
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.serve.queue import Job, JobStatus
 
 _NODES = obs_metrics.REGISTRY.counter(
@@ -161,10 +162,16 @@ def submit_campaign(engine, spec: CampaignSpec,
     spec.validate()
     workdir = workdir or engine.workdir
     cid = spec.campaign_id
+    # one trace for the whole DAG: every node job, every retry, every SCF
+    # span of the campaign carries this id (inherit an ambient trace when
+    # the caller already opened one)
+    trace_id = obs_tracing.current_trace_id() or obs_tracing.new_trace_id()
     obs_events.emit(
         "campaign_submit", campaign_id=cid, campaign_kind=spec.kind,
-        num_nodes=len(spec.nodes),
-        nodes=[n.node_id for n in spec.nodes])
+        num_nodes=len(spec.nodes), trace_id=trace_id,
+        nodes=[n.node_id for n in spec.nodes],
+        # the DAG shape, for the critical-path analyzer (obs/timeline.py)
+        edges={n.node_id: list(n.parents) for n in spec.nodes})
     jobs: dict[str, Job] = {}
     for node in spec.topo_order():
         handoff_in = None
@@ -183,6 +190,7 @@ def submit_campaign(engine, spec: CampaignSpec,
             handoff_in=handoff_in,
             handoff_out=handoff_mod.artifact_path(
                 workdir, cid, node.node_id),
+            trace_id=trace_id,
         )
         job.add_terminal_hook(_node_outcome_hook)
         jobs[node.node_id] = job

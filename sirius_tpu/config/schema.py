@@ -109,6 +109,13 @@ class ControlConfig:
     # the first blocking readback (obs/spans.py). Costs a device sync per
     # stage — bench_regress turns it on; production leaves it off.
     span_fence: bool = False
+    # collective_probe: on G-sharded runs, time each collective (halo
+    # all_to_alls, local FFT, beta psum) as a separately-jitted probe at
+    # the deck's shapes during setup, and use the per-call medians to
+    # split scf.band_solve into .compute/.collective spans (dft/scf.py).
+    # Costs a few probe compiles at startup; only active when telemetry
+    # is on and the run is actually G-sharded.
+    collective_probe: bool = True
 
 
 @dataclasses.dataclass

@@ -39,6 +39,7 @@ from sirius_tpu.obs import costs as obs_costs
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import spans as obs_spans
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger
 from sirius_tpu.obs.trace import CAPTURE as obs_trace
 from sirius_tpu.utils import checksums as _cks
@@ -128,7 +129,16 @@ def default_autosave_path(cfg, base_dir: str) -> str:
     return os.path.join(base_dir, name)
 
 
-def run_scf(
+def run_scf(*args, **kwargs) -> dict:
+    """Trace-context front door: a standalone SCF gets its own trace_id;
+    one inherited from serve/campaigns (scheduler enters the job's
+    trace_context) is kept, so every span/event of this run carries the
+    end-to-end trace. See _run_scf_inner for the full contract."""
+    with obs_tracing.ensure_trace():
+        return _run_scf_inner(*args, **kwargs)
+
+
+def _run_scf_inner(
     cfg: Config,
     base_dir: str = ".",
     restart_from: str | None = None,
@@ -621,11 +631,31 @@ def run_scf(
         g_mask = jnp.asarray(reorder_to_gshard(np.asarray(prm0.mask), g_order))
         return dict(fn=g_fn, order=g_order, sharding=g_sharding,
                     mask=g_mask, psi=None, dtype=dtype,
-                    rdt=real_dtype_of(dtype))
+                    rdt=real_dtype_of(dtype), mesh=g_mesh)
 
     if gsh_want:
         gsh = _setup_gshard(wf_dtype)
         scf_mesh = None  # the "g" mesh replaces the (k, b) mesh
+        if obs_metrics.enabled() and getattr(
+                cfg.control, "collective_probe", True):
+            # measure each named collective of the sharded apply once, in
+            # isolation, at this deck's shapes — the per-iteration
+            # compute/collective split of scf.band_solve scales these by
+            # the analytic H-application row count
+            try:
+                from sirius_tpu.parallel.dist_fft import probe_collectives
+
+                _pbatch = max(1, min(nb, 64))
+                gsh["probe"] = {
+                    "batch": _pbatch,
+                    "per_call": probe_collectives(
+                        gsh["mesh"], tuple(ctx.fft_coarse.dims), _pbatch,
+                        nbeta=int(ctx.beta.num_beta_total),
+                        ngk=int(gsh["order"].size), dtype=wf_dtype,
+                        reps=2),
+                }
+            except Exception:
+                gsh["probe"] = None
     # ---- chunked beta projectors (ops/beta_chunked.py): the dense
     # [nbeta_total, ngk] table is never materialized — each atom chunk is
     # regenerated inside the H application. Auto-dispatch mirrors gshard:
@@ -695,6 +725,14 @@ def run_scf(
         c = _stage_costs.get(stage)
         obs_spans.record(stage, dur_s, flops=c.flops if c else 0.0,
                          bytes=c.bytes if c else 0.0, **attrs)
+
+    def _hbm_attr():
+        # per-iteration HBM high-water sample (device memory_stats peak;
+        # host RSS fallback on CPU) — attached to scf.iteration spans
+        if not obs_metrics.enabled():
+            return {}
+        hw = obs_tracing.hbm_high_water()
+        return {"hbm_peak_bytes": max(hw.values())} if hw else {}
 
     def _fence(tree):
         # best-effort sync for truthful attribution (span_fence decks only)
@@ -1395,8 +1433,29 @@ def run_scf(
                 _fence((ev_dev, pr, pi))
             elif pr is not None:
                 _fence((pr, pi))
-        _stage_record("scf.band_solve", time.perf_counter() - _bs_t0,
+        _bs_dt = time.perf_counter() - _bs_t0
+        _stage_record("scf.band_solve", _bs_dt,
                       it=it + 1, num_steps=itsol.num_steps)
+        if gsh is not None and gsh.get("probe"):
+            # split the measured solve wall into collective vs compute:
+            # fenced per-collective probe costs (probe_collectives, taken
+            # once at setup) x the analytic H-application row count. A
+            # host timer cannot see inside the jitted apply, so this is a
+            # model (attrs say so) — cross-checked by bench_gshard_large
+            # against the 1-device baseline.
+            from sirius_tpu.solvers.davidson import num_applies as _napp
+
+            _pb = gsh["probe"]
+            _rows = nk * ns * _napp(itsol.num_steps, nb)
+            _coll = sum(
+                v for k, v in _pb["per_call"].items()
+                if k != "collective.fft_local"
+            ) / _pb["batch"] * _rows
+            _coll = min(_coll, _bs_dt)
+            _stage_record("scf.band_solve.collective", _coll, it=it + 1,
+                          method="probe", ndev=ndev)
+            _stage_record("scf.band_solve.compute", _bs_dt - _coll,
+                          it=it + 1, method="probe", ndev=ndev)
         # --- band-solve supervision (dft/recovery.py): a stagnated or
         # blown-up solve is retried with a deeper subspace; the serial
         # debug path additionally falls back to dense diagonalization for
@@ -1587,7 +1646,7 @@ def run_scf(
             _RMS.set(rms)
             _ETOT.set(e_total)
             _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
-                          path="fused")
+                          path="fused", **_hbm_attr())
             obs_events.emit(
                 "scf_iteration", it=it + 1, path="fused", rms=rms,
                 e_total=e_total, dt=_it_dt,
@@ -1887,7 +1946,7 @@ def run_scf(
         _RMS.set(rms)
         _ETOT.set(e_total)
         _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
-                      path="host")
+                      path="host", **_hbm_attr())
         obs_events.emit(
             "scf_iteration", it=it + 1, path="host", rms=rms,
             e_total=e_total, dt=_it_dt,

@@ -38,6 +38,7 @@ from sirius_tpu.md.extrapolate import AspcExtrapolator, SubspaceExtrapolator
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import spans as obs_spans
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger, job_context
 
 logger = get_logger("md")
@@ -122,7 +123,16 @@ def _write_xyz_frame(fh, ctx, r_cart, velocities, forces, step, e_pot_ha):
     fh.flush()
 
 
-def run_md(
+def run_md(*args, **kwargs) -> dict:
+    """Trace-context front door (see _run_md_impl): one trace for the
+    whole trajectory — every md_step and inner SCF span shares it, so a
+    timeline export reconstructs the full MD run, and an ambient trace
+    (serve/campaigns) is continued rather than forked."""
+    with obs_tracing.ensure_trace():
+        return _run_md_impl(*args, **kwargs)
+
+
+def _run_md_impl(
     cfg,
     base_dir: str = ".",
     ctx=None,

@@ -34,6 +34,13 @@ from sirius_tpu.obs.metrics import (
     update_device_memory_gauges,
 )
 from sirius_tpu.obs.trace import CAPTURE
+from sirius_tpu.obs.tracing import (
+    current_trace_id,
+    ensure_trace,
+    hbm_high_water,
+    new_trace_id,
+    trace_context,
+)
 
 # spans/costs AFTER events/metrics: spans.py imports those submodules, so
 # it must come once their attributes exist on the partial package
@@ -65,6 +72,11 @@ __all__ = [
     "peak_gflops",
     "peak_gbps",
     "xla_cost_analysis",
+    "trace_context",
+    "ensure_trace",
+    "current_trace_id",
+    "new_trace_id",
+    "hbm_high_water",
     "emit",
     "configure_events",
     "events_configured",

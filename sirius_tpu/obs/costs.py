@@ -66,6 +66,17 @@ UNCOSTED_SPANS = (
     "serve.compile",
     "serve.queue_wait",
     "campaign.finalize",
+    # model-based compute/collective split of the G-sharded band solve
+    # (probe-timed collectives x analytic apply counts, dft/scf.py)
+    "scf.band_solve.compute",
+    "scf.band_solve.collective",
+    # fenced collective probes at deck shapes (parallel/dist_fft.py)
+    "collective.all_to_all_x2y",
+    "collective.all_to_all_y2x",
+    "collective.fft_local",
+    "collective.psum_beta",
+    # timeline export work itself (obs/timeline.py)
+    "trace.export",
 )
 
 

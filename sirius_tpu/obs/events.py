@@ -46,6 +46,7 @@ import threading
 import time
 
 from sirius_tpu.obs import log as _log
+from sirius_tpu.obs import tracing as _tracing
 
 _lock = threading.Lock()
 _fh = None
@@ -97,6 +98,10 @@ def emit(kind: str, **fields) -> None:
         step = _log.current_step()
         if step is not None:
             rec["step"] = step
+    if "trace_id" not in fields:
+        tid = _tracing.current_trace_id()
+        if tid is not None:
+            rec["trace_id"] = tid
     rec.update(fields)
     line = json.dumps(rec, default=_coerce) + "\n"
     with _lock:

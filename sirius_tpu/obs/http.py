@@ -10,6 +10,10 @@ Serves, on a daemon ThreadingHTTPServer:
   the next N SCF iterations on any slice (obs/trace.py); 202 when armed,
   409 when a capture is already pending
 - ``GET /debug/trace/status`` — capture state
+- ``GET /debug/timeline[?trace_id=...&campaign=...]`` — Chrome-trace
+  JSON built live from the configured event sink (obs/timeline.py);
+  save the body and load it in ui.perfetto.dev. 409 when no event sink
+  is configured.
 
 Bound to 127.0.0.1 by default; ``port=0`` picks an ephemeral port
 (tests, CI) exposed as ``server.port``.
@@ -64,6 +68,21 @@ class _Handler(BaseHTTPRequestHandler):
                                 {"armed": armed, **CAPTURE.status()})
             elif route == "/debug/trace/status":
                 self._send_json(200, CAPTURE.status())
+            elif route == "/debug/timeline":
+                from sirius_tpu.obs import events as _events
+                from sirius_tpu.obs import timeline as _timeline
+                ev_path = _events.path()
+                if not ev_path:
+                    self._send_json(
+                        409, {"error": "no event sink configured; start "
+                                       "the engine with an events path"})
+                else:
+                    q = parse_qs(url.query)
+                    doc = _timeline.build_chrome_trace(
+                        _events.read_events(ev_path),
+                        trace_id=q.get("trace_id", [None])[0],
+                        campaign_id=q.get("campaign", [None])[0])
+                    self._send(200, json.dumps(doc), "application/json")
             else:
                 self._send_json(404, {"error": f"no route {route}"})
         except Exception as exc:

@@ -25,6 +25,9 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
+
+from sirius_tpu.obs import tracing as _tracing
 
 # ---------------------------------------------------------------------------
 # registry
@@ -53,6 +56,48 @@ def _labelkey(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+# ---------------------------------------------------------------------------
+# cardinality guard
+#
+# Label values must come from small closed sets (stage names, failure
+# classes, device ids) — NEVER from per-request identity (job_id,
+# trace_id, campaign node). Those ride on events and exemplars instead.
+# As a backstop against a producer regressing this rule, each family caps
+# its labelset count; updates past the cap collapse into a single
+# {overflow="true"} child and are tallied in ``cardinality_clips()`` so
+# tests (and a dashboard) can alert on the leak without the registry
+# eating unbounded memory first.
+
+_MAX_LABELSETS_DEFAULT = 128
+_max_labelsets = _MAX_LABELSETS_DEFAULT
+_OVERFLOW_KEY = (("overflow", "true"),)
+_clips_lock = threading.Lock()
+_clips: dict[str, int] = {}
+
+
+def set_max_labelsets(n: int) -> int:
+    """Set the per-family labelset cap; returns the previous cap."""
+    global _max_labelsets
+    prev = _max_labelsets
+    _max_labelsets = int(n)
+    return prev
+
+
+def max_labelsets() -> int:
+    return _max_labelsets
+
+
+def cardinality_clips() -> dict[str, int]:
+    """{family name: updates routed to the overflow child}."""
+    with _clips_lock:
+        return dict(_clips)
+
+
+def _note_clip(name: str) -> None:
+    with _clips_lock:
+        _clips[name] = _clips.get(name, 0) + 1
+
+
 class _Family:
     """One named metric family; children are keyed by their label set."""
 
@@ -63,15 +108,39 @@ class _Family:
         self.help = help
         self._lock = threading.Lock()
         self._children: dict[tuple, object] = {}
+        self._exemplars: dict[tuple, dict] = {}
 
-    def _child(self, labels: dict):
+    def _child_keyed(self, labels: dict):
         key = _labelkey(labels)
         with self._lock:
             c = self._children.get(key)
             if c is None:
-                c = self._new_child()
-                self._children[key] = c
-            return c
+                if (key != _OVERFLOW_KEY
+                        and len(self._children) >= _max_labelsets):
+                    _note_clip(self.name)
+                    key = _OVERFLOW_KEY
+                    c = self._children.get(key)
+                if c is None:
+                    c = self._new_child()
+                    self._children[key] = c
+            return key, c
+
+    def _child(self, labels: dict):
+        return self._child_keyed(labels)[1]
+
+    def _note_exemplar(self, key: tuple, value: float) -> None:
+        """Attach the current trace to this sample (last-write-wins) —
+        the OpenMetrics exemplar idea: per-identity correlation lives
+        here, not in label values. Caller holds self._lock."""
+        tid = _tracing.current_trace_id()
+        if tid is not None:
+            self._exemplars[key] = {
+                "trace_id": tid, "value": float(value), "ts": time.time()}
+
+    def exemplar(self, **labels) -> dict | None:
+        with self._lock:
+            ex = self._exemplars.get(_labelkey(labels))
+            return dict(ex) if ex else None
 
     def labelsets(self) -> list[tuple]:
         with self._lock:
@@ -89,9 +158,10 @@ class Counter(_Family):
     def inc(self, amount: float = 1.0, **labels) -> None:
         if not _enabled:
             return
-        c = self._child(labels)
+        key, c = self._child_keyed(labels)
         with self._lock:
             c[0] += amount
+            self._note_exemplar(key, c[0])
 
     def value(self, **labels) -> float:
         return self._child(labels)[0]
@@ -149,12 +219,13 @@ class Histogram(_Family):
     def observe(self, value: float, **labels) -> None:
         if not _enabled:
             return
-        c = self._child(labels)
+        key, c = self._child_keyed(labels)
         i = bisect.bisect_left(self.buckets, float(value))
         with self._lock:
             c["counts"][i] += 1
             c["sum"] += float(value)
             c["n"] += 1
+            self._note_exemplar(key, value)
 
     def child_stats(self, **labels) -> dict:
         c = self._child(labels)
@@ -200,6 +271,8 @@ class MetricsRegistry:
         """Drop every family (tests only)."""
         with self._lock:
             self._families.clear()
+        with _clips_lock:
+            _clips.clear()
 
     # -- exporters --------------------------------------------------------
 
@@ -212,11 +285,13 @@ class MetricsRegistry:
             for key in fam.labelsets():
                 labels = dict(key)
                 if fam.kind == "histogram":
-                    samples.append({"labels": labels,
-                                    **fam.child_stats(**labels)})
+                    sample = {"labels": labels, **fam.child_stats(**labels)}
                 else:
-                    samples.append({"labels": labels,
-                                    "value": fam.value(**labels)})
+                    sample = {"labels": labels, "value": fam.value(**labels)}
+                ex = fam.exemplar(**labels)
+                if ex is not None:
+                    sample["exemplar"] = ex
+                samples.append(sample)
             out[fam.name] = {"type": fam.kind, "help": fam.help,
                              "samples": samples}
         return out

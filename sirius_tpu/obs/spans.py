@@ -46,6 +46,7 @@ import time
 
 from sirius_tpu.obs import events as _events
 from sirius_tpu.obs import metrics as _metrics
+from sirius_tpu.obs import tracing as _tracing
 
 # the innermost live span of this logical context (contextvar, not a
 # thread-local stack: lineage must survive contextvars-aware frameworks
@@ -164,6 +165,7 @@ class span:
             "depth": self.depth,
             "t0": self._t0_wall,
             "dur_s": self.dur_s,
+            **_tracing.context_fields(),
         }
         if exc_type is not None:
             rec["error"] = exc_type.__name__
@@ -193,6 +195,7 @@ def record(name: str, dur_s: float, t0: float | None = None,
         "depth": (parent.depth + 1) if parent is not None else 0,
         "t0": float(t0) if t0 is not None else time.time() - float(dur_s),
         "dur_s": float(dur_s),
+        **_tracing.context_fields(),
     }
     if attrs:
         rec.update(attrs)

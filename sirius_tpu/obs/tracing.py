@@ -1,0 +1,130 @@
+"""Trace-context propagation — the distributed layer of the obs stack.
+
+A *trace* is one logical unit of user-visible work: a serve job from
+submit to terminal state, a whole campaign DAG, an MD trajectory. The
+span timeline (obs/spans.py) gives lineage *within* one context via
+parent_id; this module gives identity *across* contexts — worker
+threads, process restarts (journal replay), and DAG handoff between
+jobs — by carrying a 16-hex ``trace_id`` in a contextvar that every
+span record, event, and metric exemplar stamps on itself.
+
+Propagation paths (who carries the id across which boundary):
+
+- serve: ``ServeEngine.submit`` assigns a trace_id to the Job *before*
+  write-ahead journaling, so SIGKILL + journal replay reconstructs the
+  same trace; ``scheduler._run_job`` enters ``trace_context(job.trace_id)``
+  around every attempt, so all SCF spans from any worker thread / retry
+  land on the job's trace.
+- campaigns: ``runner.submit_campaign`` mints one trace_id for the whole
+  DAG and passes it to every node's submit; the handoff artifact
+  (campaigns/handoff.py) stores it too, so a child job warm-started in a
+  *fresh process* (resume after SIGKILL) still continues the campaign's
+  trace.
+- drivers: ``run_scf`` / ``run_md`` call ``ensure_trace()`` — standalone
+  runs get a fresh trace, runs under serve/campaigns keep the inherited
+  one.
+
+This module is deliberately stdlib-only at import time (obs/__init__.py
+imports events/metrics before spans; tracing must be importable by all
+of them without cycles). jax is imported lazily inside
+``hbm_high_water`` only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import uuid
+
+_trace_var: contextvars.ContextVar = contextvars.ContextVar(
+    "sirius_tpu_trace_id", default=None)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (random, process-unique, journal-safe)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> str | None:
+    """The trace id of this logical context (None outside any trace)."""
+    return _trace_var.get()
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: str | None = None):
+    """Enter a trace: ``with trace_context(job.trace_id):``. With
+    ``trace_id=None`` a fresh id is minted. Yields the active id; restores
+    the previous context on exit (nesting re-enters the same or a child
+    trace — span lineage, not trace ids, expresses nesting)."""
+    tid = trace_id or new_trace_id()
+    token = _trace_var.set(tid)
+    try:
+        yield tid
+    finally:
+        _trace_var.reset(token)
+
+
+@contextlib.contextmanager
+def ensure_trace():
+    """Keep the inherited trace if one is active, else mint one. The
+    driver-entry idiom: run_scf/run_md wrap their body in this so
+    standalone runs are traced without serve knowing, and serve-run SCFs
+    join their job's trace instead of forking a new one."""
+    tid = _trace_var.get()
+    if tid is not None:
+        yield tid
+        return
+    with trace_context() as tid:
+        yield tid
+
+
+def context_fields() -> dict:
+    """The stamp applied to span records and events: trace_id (when a
+    trace is active) plus the physical coordinates (pid, thread) that
+    the timeline exporter turns into Perfetto tracks."""
+    out = {"pid": os.getpid(), "thread": threading.current_thread().name}
+    tid = _trace_var.get()
+    if tid is not None:
+        out["trace_id"] = tid
+    return out
+
+
+def hbm_high_water() -> dict:
+    """Per-device peak memory since process start, in bytes:
+    ``{"tpu:0": 123456, ...}``. CPU backends report no memory_stats; then
+    falls back to the process RSS high-water (``host_rss``) so the
+    GSHARD bench has *a* memory axis on every platform. Best-effort:
+    returns {} when nothing is measurable."""
+    out: dict = {}
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            stats = None
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use"))
+            if peak is not None:
+                out[f"{dev.platform}:{dev.id}"] = int(peak)
+    except Exception:
+        pass
+    if not out:
+        try:
+            import resource
+
+            import sys
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # linux reports KiB, macOS bytes; normalize to bytes
+            scale = 1 if sys.platform == "darwin" else 1024
+            out["host_rss"] = int(rss) * scale
+        except Exception:
+            pass
+    return out

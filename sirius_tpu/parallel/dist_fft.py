@@ -56,17 +56,21 @@ def _reslab_x_to_y(slab, axis_name: str):
     y-block from all x-slabs, and concatenate along x."""
     # slab: [..., n1p, n2, n3] -> split axis -2 into P chunks, all_to_all
     # over the chunk axis, then merge the received x-chunks along axis -3
-    return jax.lax.all_to_all(
-        slab, axis_name, split_axis=slab.ndim - 2, concat_axis=slab.ndim - 3,
-        tiled=True,
-    )
+    # (named_scope tags the HLO so device profiles and xprof group the
+    # exchange under a stable name the timeline exporter knows)
+    with jax.named_scope("collective.all_to_all_x2y"):
+        return jax.lax.all_to_all(
+            slab, axis_name, split_axis=slab.ndim - 2,
+            concat_axis=slab.ndim - 3, tiled=True,
+        )
 
 
 def _reslab_y_to_x(slab, axis_name: str):
-    return jax.lax.all_to_all(
-        slab, axis_name, split_axis=slab.ndim - 3, concat_axis=slab.ndim - 2,
-        tiled=True,
-    )
+    with jax.named_scope("collective.all_to_all_y2x"):
+        return jax.lax.all_to_all(
+            slab, axis_name, split_axis=slab.ndim - 3,
+            concat_axis=slab.ndim - 2, tiled=True,
+        )
 
 
 def fft3d_shard(slab, axis_name: str = "g"):
@@ -250,9 +254,11 @@ def _gshard_inner(mesh: Mesh, n1p: int, n2: int, n3: int):
         hpsi = jnp.where(mask_loc > 0, ekin_loc, 0.0) * psi_loc + vpsi
         spsi = psi_loc
         if beta_loc.shape[0]:
-            bp = jax.lax.psum(
-                jnp.einsum("xg,bg->bx", jnp.conj(beta_loc), psi_loc), "g"
-            )
+            with jax.named_scope("collective.psum_beta"):
+                bp = jax.lax.psum(
+                    jnp.einsum("xg,bg->bx", jnp.conj(beta_loc), psi_loc),
+                    "g",
+                )
             hpsi = hpsi + jnp.einsum("bx,xy,yg->bg", bp, dion_r, beta_loc)
             spsi = spsi + jnp.einsum("bx,xy,yg->bg", bp, qmat_r, beta_loc)
         return hpsi * mask_loc, spsi * mask_loc
@@ -329,3 +335,106 @@ def make_apply_h_s_gshard(mesh: Mesh, dims, lidx, ekin_g, mask_g,
     apply_h_s_gshard.sharding_veff = veff_sharding
     apply_h_s_gshard.veff0 = veff_d
     return apply_h_s_gshard, gshard
+
+
+# ---------------------------------------------------------------------------
+# collective attribution probes
+#
+# A host timer cannot see inside one jitted apply — the exchanges, local
+# FFTs, and the beta psum all fuse into one program. These probes compile
+# each piece SEPARATELY at the deck's real shapes, warm it, then time it
+# fenced, giving a measured per-call cost for every named collective. The
+# SCF layer multiplies these by analytic apply counts to split the
+# measured scf.band_solve wall into compute vs collective sub-spans, and
+# bench_gshard_large writes them per-ndev into GSHARD_LARGE.json.
+# ---------------------------------------------------------------------------
+
+
+def probe_collectives(mesh: Mesh, dims: tuple[int, int, int], batch: int,
+                      nbeta: int = 0, ngk: int | None = None,
+                      dtype=jnp.complex128, reps: int = 3) -> dict:
+    """Time each named collective of the G-sharded apply in isolation.
+
+    batch: the band-block size the solver actually applies (nb rows per
+    H.psi). ngk: padded G-count for the beta-psum probe (defaults to the
+    box volume / 8, roughly the cutoff-sphere fill of a production deck).
+    Returns {span_name: seconds per call (median of reps)}; each probe
+    also records a ``collective.*`` span so the timeline shows them.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from sirius_tpu.obs import spans as _spans
+
+    npg = mesh.shape["g"]
+    n1, n2, n3 = dims
+    if n1 % npg or n2 % npg:
+        raise ValueError(f"box dims {dims} not divisible by g={npg}")
+    xs = NamedSharding(mesh, x_slab_spec())
+    ys = NamedSharding(mesh, y_slab_spec())
+
+    box = jax.device_put(
+        jnp.ones((batch, n1, n2, n3), dtype=dtype), xs)
+    box_y = jax.device_put(
+        jnp.ones((batch, n1, n2, n3), dtype=dtype), ys)
+
+    def _fft_local_apply(slab):
+        # the four local-FFT stages of one apply, exchanges elided
+        fr = jnp.fft.ifftn(slab, axes=(-2, -1))
+        fr = jnp.fft.ifft(fr, axis=-3)
+        fr = jnp.fft.fft(fr, axis=-3)
+        return jnp.fft.fftn(fr, axes=(-2, -1))
+
+    probes: dict[str, tuple] = {
+        "collective.all_to_all_x2y": (
+            jax.jit(_shard_map(
+                partial(_reslab_x_to_y, axis_name="g"), mesh=mesh,
+                in_specs=x_slab_spec(), out_specs=y_slab_spec()),
+                in_shardings=xs, out_shardings=ys),
+            (box,)),
+        "collective.all_to_all_y2x": (
+            jax.jit(_shard_map(
+                partial(_reslab_y_to_x, axis_name="g"), mesh=mesh,
+                in_specs=y_slab_spec(), out_specs=x_slab_spec()),
+                in_shardings=ys, out_shardings=xs),
+            (box_y,)),
+        "collective.fft_local": (
+            jax.jit(_shard_map(
+                _fft_local_apply, mesh=mesh,
+                in_specs=x_slab_spec(), out_specs=x_slab_spec()),
+                in_shardings=xs, out_shardings=xs),
+            (box,)),
+    }
+
+    if nbeta > 0:
+        if ngk is None:
+            ngk = max(npg, (n1 * n2 * n3) // 8 // npg * npg)
+        gsh = NamedSharding(mesh, P(None, "g"))
+        psi = jax.device_put(jnp.ones((batch, ngk), dtype=dtype), gsh)
+        beta = jax.device_put(jnp.ones((nbeta, ngk), dtype=dtype), gsh)
+
+        def _beta_psum(b, p):
+            with jax.named_scope("collective.psum_beta"):
+                return jax.lax.psum(
+                    jnp.einsum("xg,bg->bx", jnp.conj(b), p), "g")
+
+        probes["collective.psum_beta"] = (
+            jax.jit(_shard_map(
+                _beta_psum, mesh=mesh,
+                in_specs=(P(None, "g"), P(None, "g")), out_specs=P())),
+            (beta, psi))
+
+    out = {}
+    for name, (fn, arglist) in probes.items():
+        jax.block_until_ready(fn(*arglist))  # compile + warm
+        times = []
+        for _ in range(max(1, reps)):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(*arglist))
+            times.append(_time.perf_counter() - t0)
+        med = float(np.median(times))
+        _spans.record(name, med, ndev=npg, batch=batch,
+                      dims=list(dims), reps=len(times))
+        out[name] = med
+    return out

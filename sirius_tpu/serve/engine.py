@@ -48,6 +48,7 @@ import time
 from sirius_tpu import obs
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.serve import journal as journal_mod
 from sirius_tpu.serve.cache import ExecutableCache
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
@@ -145,6 +146,7 @@ class ServeEngine:
             node_id=rec.get("node_id"),
             handoff_in=rec.get("handoff_in"),
             handoff_out=rec.get("handoff_out"),
+            trace_id=rec.get("trace_id"),
         )
         job.resume_path = self._find_replay_autosave(job)
         job.add_terminal_hook(self._journal_terminal)
@@ -212,7 +214,8 @@ class ServeEngine:
                campaign_id: str | None = None,
                node_id: str | None = None,
                handoff_in: dict | None = None,
-               handoff_out: str | None = None) -> Job:
+               handoff_out: str | None = None,
+               trace_id: str | None = None) -> Job:
         """Admit a job. Raises QueueFullError when the queue is bounded
         and full (immediately, or after ``timeout`` with ``block=True``).
         With a journal, the submission is durable before it is queued.
@@ -226,6 +229,11 @@ class ServeEngine:
             wall_time_budget=wall_time_budget,
             parents=parents, campaign_id=campaign_id, node_id=node_id,
             handoff_in=handoff_in, handoff_out=handoff_out,
+            # trace identity BEFORE journaling: explicit id (campaigns) >
+            # the caller's ambient trace > a fresh one — so replay after
+            # SIGKILL continues the same end-to-end trace
+            trace_id=(trace_id or obs_tracing.current_trace_id()
+                      or obs_tracing.new_trace_id()),
         )
         job.add_terminal_hook(self._notify_terminal)
         if self.journal is not None:

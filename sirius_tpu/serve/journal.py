@@ -111,6 +111,7 @@ class JobJournal:
             "deadline": job.deadline,
             "max_retries": job.max_retries,
             "wall_time_budget": job.wall_time_budget,
+            "trace_id": getattr(job, "trace_id", None),
             "ts": job.submitted_at,
         }
         if getattr(job, "campaign_id", None) or getattr(job, "parents", None):

@@ -112,7 +112,8 @@ class Job:
                  campaign_id: str | None = None,
                  node_id: str | None = None,
                  handoff_in: dict | None = None,
-                 handoff_out: str | None = None):
+                 handoff_out: str | None = None,
+                 trace_id: str | None = None):
         self.id = job_id or f"job-{id(self):x}"
         self.deck = deck
         self.base_dir = base_dir
@@ -132,6 +133,10 @@ class Job:
         # handoff_out: artifact path this job writes on DONE
         self.handoff_in = dict(handoff_in) if handoff_in else None
         self.handoff_out = handoff_out
+        # end-to-end trace identity (obs/tracing.py): assigned by the
+        # engine before journaling so SIGKILL+replay keeps the same trace;
+        # campaigns pass one id for the whole DAG
+        self.trace_id = trace_id
         self.status = JobStatus.QUEUED
         self.events: list[tuple[float, str, str]] = []
         self.result: dict | None = None
@@ -178,6 +183,8 @@ class Job:
         self.events.append((now, status, detail))
         _TRANSITIONS.inc(status=status)
         extra = {"campaign_id": self.campaign_id} if self.campaign_id else {}
+        if self.trace_id:
+            extra["trace_id"] = self.trace_id
         obs_events.emit("job_transition", job_id=self.id, status=status,
                         detail=detail, attempt=self.attempts, **extra)
         if status in TERMINAL:
@@ -210,6 +217,7 @@ class Job:
         return {
             "id": self.id,
             "status": self.status,
+            "trace_id": self.trace_id,
             "campaign_id": self.campaign_id,
             "node_id": self.node_id,
             "parents": list(self.parents),

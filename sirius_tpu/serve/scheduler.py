@@ -53,6 +53,7 @@ import numpy as np
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import spans as obs_spans
+from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger, job_context
 from sirius_tpu.serve import cache as cache_mod
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
@@ -197,8 +198,21 @@ class SliceScheduler:
 
     def _run_job(self, job: Job, slice_idx: int, devs, epoch: int) -> None:
         job.attempts += 1
-        # every log line and obs event inside the attempt carries job.id
-        with job_context(job.id):
+        if job.trace_id is None and job.handoff_in:
+            # a job joining a DAG without engine.submit's assignment:
+            # continue the trace stored in the parent's handoff artifact
+            from sirius_tpu.campaigns import handoff as handoff_mod
+
+            job.trace_id = handoff_mod.artifact_trace_id(
+                job.handoff_in.get("path"))
+        if job.trace_id is None:
+            # direct queue users bypass engine.submit; give the job a
+            # trace here so every attempt still has end-to-end identity
+            job.trace_id = obs_tracing.new_trace_id()
+        # every log line and obs event inside the attempt carries job.id,
+        # and every span/event/exemplar the job's trace_id — across
+        # worker threads, retries, and (via the journal) process restarts
+        with obs_tracing.trace_context(job.trace_id), job_context(job.id):
             if faults.armed("serve.worker_crash", job.attempts - 1):
                 raise faults.WorkerCrash(
                     f"fault serve.worker_crash (job {job.id} "
