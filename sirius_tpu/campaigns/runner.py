@@ -138,6 +138,15 @@ class CampaignHandle:
             for nid, job in self.jobs.items()
             if job.status == JobStatus.DONE and isinstance(job.result, dict)
         }
+        # per-node convergence-forecast record (obs/forecast.py via
+        # run_scf): forecast accuracy across a DAG is a campaign-level
+        # health signal — a template whose nodes systematically run past
+        # their forecasts is mis-budgeted
+        out["forecast"] = {
+            nid: job.result.get("forecast")
+            for nid, job in self.jobs.items()
+            if job.status == JobStatus.DONE and isinstance(job.result, dict)
+        }
         try:
             out["summary"] = self.finalize()
         except (ValueError, KeyError) as e:

@@ -116,6 +116,34 @@ class ControlConfig:
     # Costs a few probe compiles at startup; only active when telemetry
     # is on and the run is actually G-sharded.
     collective_probe: bool = True
+    # numerics observatory (obs/numerics.py): numerics_probe runs the
+    # per-stage precision-headroom shadow probes every
+    # numerics_probe_every iterations on the host path and once at the
+    # final iterate on either path ("scf.numerics_probe" span,
+    # "numerics_probe" events, result["numerics"]). Off by default: the
+    # probes re-evaluate stages at reduced precision, which is shadow
+    # work production runs do not want per iteration.
+    numerics_probe: bool = False
+    numerics_probe_every: int = 10
+    # convergence analytics (obs/forecast.py + dft/recovery.py):
+    # forecast_enabled feeds the log-linear decay-rate fit, the
+    # iterations-to-converge forecast ("scf_forecast" events, the
+    # scf_forecast_iterations gauge) and the divergence early-warning
+    # score. A warning score >= forecast_warning_threshold triggers a
+    # proactive rollback snapshot on the fused path; a sustained run of
+    # high scores (forecast_backoff_iters, default rms_divergence_iters/2
+    # floored at 3) with the rms forecast_backoff_ratio above the streak
+    # start fires the "forecast_divergence" sentinel BEFORE the
+    # non-finite/rms sentinels would trip.
+    forecast_enabled: bool = True
+    forecast_warning_threshold: float = 0.5
+    forecast_backoff_iters: int = 0  # 0 = derive from rms_divergence_iters
+    forecast_backoff_ratio: float = 10.0
+    # deadline feasibility (serve/scheduler.py): wall-clock deadline as a
+    # unix timestamp (0 = none). run_scf compares it against the
+    # forecasted remaining iterations x the recent iteration time and
+    # emits "deadline_feasibility" events when the verdict changes.
+    deadline_ts: float = 0.0
 
 
 @dataclasses.dataclass

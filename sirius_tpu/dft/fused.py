@@ -80,7 +80,13 @@ S_MAG = 12  # total moment from m_out (pre-mix)
 S_V0 = 13  # Re veff(G=0)
 S_ENT = 14  # smearing entropy sum
 S_FINITE = 15  # 1.0 when the mixed vector and new potential are all-finite
-NUM_SCALARS = 16
+# -- numerics ledger (obs/numerics.py): cheap per-iteration invariants
+# appended to the SAME record, so they ride the one existing readback --
+S_ORTHO = 16  # max |psi^H S psi - I| (S-orthonormality of the band block)
+S_CHG = 17  # |Re x_mixed[0] - Re x_new[0]| * omega (mixer charge drift)
+S_SYM = 18  # max |P_sym rho_new - rho_new| (symmetrization idempotency)
+S_HERM = 19  # max |H_nl - H_nl^H| (subspace nonlocal-H hermiticity)
+NUM_SCALARS = 20
 
 
 class FusedCarry(NamedTuple):
@@ -138,6 +144,11 @@ class FusedScf:
             "dion": np.real(np.asarray(ctx.beta.dion))
             if nbeta
             else np.zeros((0, 0)),
+            # bare augmentation overlap Q: the S metric of the ledger's
+            # orthonormality invariant (same table make_hkset_params uses)
+            "qmat": np.real(np.asarray(ctx.beta.qmat))
+            if (nbeta and ctx.beta.qmat is not None)
+            else np.zeros((nbeta, nbeta)),
         }
         if beta_dev is not None:
             tables["beta_re"], tables["beta_im"] = beta_dev
@@ -250,14 +261,16 @@ class FusedScf:
                              + 1j * np.asarray(carry.hf_im)[:m])
         return x, hist
 
-    def step(self, carry, acc, dm_re, dm_im, ev, occ_w, ent):
+    def step(self, carry, acc, dm_re, dm_im, ev, occ_w, ent, pr, pi):
         """One fused iteration. acc: [ns, coarse box] occupation-weighted
         |psi(r)|^2 from density_kset; (dm_re, dm_im): [ns, nbeta, nbeta]
         from density_matrix_kset (empty for norm-conserving); ev: [nk, ns,
-        nb] float64 eigenvalues; occ_w = occ * kweights; ent: entropy sum.
-        All device arrays. Returns (new_carry, out_dict)."""
+        nb] float64 eigenvalues; occ_w = occ * kweights; ent: entropy sum;
+        (pr, pi): [nk, ns, nb, ngk] band block (already live on device for
+        density_kset — feeding it here adds no transfer) for the numerics
+        ledger. All device arrays. Returns (new_carry, out_dict)."""
         return self._step(self.tables, carry, acc, dm_re, dm_im, ev,
-                          occ_w, ent)
+                          occ_w, ent, pr, pi)
 
     def finalize(self, carry, out) -> dict:
         """The single end-of-loop host fetch: mixed density, D matrices,
@@ -288,7 +301,8 @@ class FusedScf:
 
     # -- the compiled program --------------------------------------------
 
-    def _step_impl(self, tables, carry, acc, dm_re, dm_im, ev, occ_w, ent):
+    def _step_impl(self, tables, carry, acc, dm_re, dm_im, ev, occ_w, ent,
+                   pr, pi):
         ng, ns, omega = self.ng, self.ns, self.omega
         cdt = jnp.complex128
 
@@ -383,6 +397,46 @@ class FusedScf:
             tables["beta_im"], dion_new, v0,
         )
 
+        # ---- numerics ledger: per-iteration invariants, same record ----
+        # Note the choice of invariants: quantities whose exact value is
+        # known (I, 0) so the scalar directly reads as accumulated rounding
+        # + algorithmic drift. The Gram matrix itself and the density
+        # matrix are hermitian BITWISE in IEEE arithmetic (conjugate-mirror
+        # products round identically), so their asymmetry is useless; the
+        # chained-GEMM subspace H_nl below is not mirror-exact and does
+        # measure rounding. dion here is the BARE table (not dion_new):
+        # host and device then score the identical quantity regardless of
+        # where each path is in its D-refresh cycle.
+        psi_c = jax.lax.complex(
+            pr.astype(jnp.float64), pi.astype(jnp.float64)
+        ) * tables["gmask"][:, None, None, :]
+        beta_c = jax.lax.complex(
+            tables["beta_re"].astype(jnp.float64),
+            tables["beta_im"].astype(jnp.float64),
+        )
+        qmat64 = tables["qmat"].astype(jnp.float64)
+        bp = jnp.einsum("kxg,ksbg->ksbx", jnp.conj(beta_c), psi_c)
+        gram = jnp.einsum("ksbg,kscg->ksbc", jnp.conj(psi_c), psi_c)
+        gram = gram + jnp.einsum(
+            "ksbx,xy,kscy->ksbc", jnp.conj(bp), qmat64, bp
+        )
+        nb = psi_c.shape[2]
+        s_ortho = jnp.max(jnp.abs(gram - jnp.eye(nb, dtype=gram.dtype)))
+        s_chg = jnp.abs(
+            jnp.real(x_mixed[0]) - jnp.real(x_new[0])
+        ) * omega
+        if self.do_symmetrize:
+            s_sym = jnp.max(jnp.abs(
+                symmetrize_pw_device(rho_new, tables["sym"]) - rho_new
+            ))
+        else:
+            s_sym = jnp.zeros((), dtype=jnp.float64)
+        dion64 = tables["dion"].astype(jnp.float64)
+        h_nl = jnp.einsum("ksbx,xy,kscy->ksbc", jnp.conj(bp), dion64, bp)
+        s_herm = jnp.max(jnp.abs(
+            h_nl - jnp.conj(jnp.swapaxes(h_nl, -1, -2))
+        ))
+
         eval_sum = jnp.sum(occ_w * ev)
         e = pot["energies"]
         # device-side health sentinel (dft/recovery.py): a NaN anywhere in
@@ -401,6 +455,7 @@ class FusedScf:
             rms, eha, e["vha"], e["vxc"], e["vloc"], e["veff"], e["exc"],
             e["bxc"], e1, e2, eval_sum, nel_got, mag_moment, v0,
             ent.astype(jnp.float64), finite,
+            s_ortho, s_chg, s_sym, s_herm,
         ])
 
         if self.polarized:

@@ -12,7 +12,9 @@ the whole run.
 ScfSupervisor turns those sites into a bounded retry loop:
 
   sentinel fires (non-finite field, energy blow-up, RMS growing for K
-  consecutive iterations)
+  consecutive iterations, or — earlier — the forecast early-warning score
+  of obs/forecast.py staying high while the residual climbs an order of
+  magnitude)
     -> roll back to the last finite (x_mix, energy) snapshot
     -> escalate one rung of the backoff ladder:
          rung 0: flush Anderson/Broyden history (a poisoned history is the
@@ -39,6 +41,7 @@ import numpy as np
 
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.forecast import ConvergenceForecaster
 
 _RECOVERIES = obs_metrics.REGISTRY.counter(
     "scf_recoveries_total", "recovery-ladder rungs taken, by action")
@@ -80,7 +83,7 @@ class ScfSupervisor:
     hands out ladder directives when a sentinel fires."""
 
     def __init__(self, control, mixer_beta: float, mixer_kind: str,
-                 deck_label: str = ""):
+                 deck_label: str = "", density_tol: float | None = None):
         self.enabled = bool(getattr(control, "scf_supervision", True))
         self.max_recoveries = int(getattr(control, "max_recoveries", 3))
         self.rms_divergence_iters = int(
@@ -88,6 +91,25 @@ class ScfSupervisor:
         self.energy_blowup_tol = float(
             getattr(control, "energy_blowup_tol", 1e4))
         self.diag_dump = str(getattr(control, "diag_dump", ""))
+        # convergence analytics (obs/forecast.py): early-warning score +
+        # iterations-to-converge forecast, fed the same observe() scalars
+        self.forecast_enabled = bool(
+            getattr(control, "forecast_enabled", True))
+        self.forecast_warning_threshold = float(
+            getattr(control, "forecast_warning_threshold", 0.5))
+        self.forecast_backoff_ratio = float(
+            getattr(control, "forecast_backoff_ratio", 10.0))
+        # the forecast sentinel acts EARLIER than rms_divergence (half the
+        # streak) but never instantly: a floor of 3 keeps one bad Anderson
+        # step from costing a rollback
+        self.forecast_backoff_iters = (
+            int(getattr(control, "forecast_backoff_iters", 0))
+            or max(3, self.rms_divergence_iters // 2))
+        self.forecaster = ConvergenceForecaster(
+            density_tol if density_tol is not None else 0.0)
+        self._fc_streak = 0
+        self._fc_start_rms: float | None = None
+        self._fc_snap: dict | None = None
         self.deck_label = deck_label
         self.beta0 = float(mixer_beta)
         self.kind0 = str(mixer_kind)
@@ -129,6 +151,7 @@ class ScfSupervisor:
         sentinels are reported directly via recover().)"""
         self._etot_tail = (self._etot_tail + [float(e_total)])[-8:]
         self._rms_tail = (self._rms_tail + [float(rms)])[-8:]
+        self._fc_snap = self.forecaster.update(it, rms, e_total)
         if not self.enabled:
             self._e_prev = e_total
             return None
@@ -154,14 +177,73 @@ class ScfSupervisor:
             self._rms_streak = 0
             self._streak_start_rms = None
             return "rms_divergence"
+        # forecast early warning (obs/forecast.py): backoff BEFORE the
+        # non-finite/rms sentinels can trip. A separate streak from the
+        # rms sentinel above: that one counts monotone growth, this one
+        # counts sustained high warning scores — sharing state would
+        # change the rms sentinel's firing pattern. The 10x-above-streak-
+        # start guard keeps the mandatory early-run warnings (score 1.0
+        # until the forecaster has min_history samples) from ever costing
+        # a rollback on a healthy trajectory.
+        if self.forecast_enabled:
+            if self._fc_snap["warning"] >= self.forecast_warning_threshold:
+                if self._fc_streak == 0:
+                    self._fc_start_rms = float(rms)
+                self._fc_streak += 1
+            else:
+                self._fc_streak = 0
+                self._fc_start_rms = None
+            if (self._fc_streak >= self.forecast_backoff_iters
+                    and self._fc_start_rms is not None
+                    and np.isfinite(rms)
+                    and rms > self.forecast_backoff_ratio
+                    * max(self._fc_start_rms, 1e-300)):
+                self._fc_streak = 0
+                self._fc_start_rms = None
+                return "forecast_divergence"
         return None
+
+    def should_snapshot(self) -> bool:
+        """Proactive-snapshot trigger: True while the early-warning score
+        is at or above the threshold (including the first iterations,
+        where no contraction evidence exists yet). run_scf ORs this into
+        its fused-path snapshot cadence so a rollback after an early fault
+        lands on the newest trusted iterate instead of one up to
+        snapshot_every iterations stale."""
+        return (self.enabled and self.forecast_enabled
+                and self._fc_snap is not None
+                and self._fc_snap["warning"]
+                >= self.forecast_warning_threshold)
+
+    def forecast_snapshot(self) -> dict | None:
+        """The forecaster's view after the last observe() (obs/forecast.py
+        snapshot dict); None before the first iteration."""
+        return self._fc_snap
+
+    def inject_warning(self, score: float = 1.0) -> None:
+        """Force the last forecast snapshot's early-warning score (fault
+        site scf.forecast_misfire): exercises the proactive-snapshot and
+        deadline-infeasibility consumers without a real divergence. The
+        remaining-iterations forecast is dropped alongside — a run that
+        warrants maximum warning has no credible convergence estimate."""
+        if self._fc_snap is None:
+            self._fc_snap = self.forecaster.snapshot()
+        self._fc_snap = dict(
+            self._fc_snap, warning=float(score),
+            forecast_remaining=None, forecast_total=None,
+        )
 
     def reset_trend(self) -> None:
         """Clear soft-sentinel trend state after a rollback (the restored
-        iterate restarts the energy/rms trajectory)."""
+        iterate restarts the energy/rms trajectory — the poisoned tail
+        must not contaminate the post-rollback decay fit either)."""
         self._rms_streak = 0
         self._streak_start_rms = None
         self._e_prev = None
+        self._fc_streak = 0
+        self._fc_start_rms = None
+        self._fc_snap = None
+        self.forecaster.reset()
 
     # -- recovery ---------------------------------------------------------
 
@@ -238,6 +320,7 @@ class ScfSupervisor:
             "mixer_beta0": self.beta0,
             "mixer_kind0": self.kind0,
             "detail": detail,
+            "forecast": self._fc_snap,
         }
         if state:
             diag.update(state)

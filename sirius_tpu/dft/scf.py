@@ -38,6 +38,7 @@ from sirius_tpu.solvers.davidson import davidson
 from sirius_tpu.obs import costs as obs_costs
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import numerics as obs_numerics
 from sirius_tpu.obs import spans as obs_spans
 from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger
@@ -62,6 +63,12 @@ _RUNS = obs_metrics.REGISTRY.counter(
     "scf_runs_total", "run_scf completions by outcome")
 _AUTOSAVES = obs_metrics.REGISTRY.counter(
     "scf_autosaves_total", "mid-run checkpoint writes")
+_FORECAST_ITERS = obs_metrics.REGISTRY.gauge(
+    "scf_forecast_iterations",
+    "forecasted total SCF iterations to convergence (obs/forecast.py)")
+_FORECAST_WARNING = obs_metrics.REGISTRY.gauge(
+    "scf_forecast_warning",
+    "divergence early-warning score in [0, 1] (obs/forecast.py)")
 
 
 def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
@@ -838,8 +845,8 @@ def _run_scf_inner(
     ):
         from sirius_tpu.dft.fused import (
             FusedScf,
-            S_BXC, S_E1, S_E2, S_EHA, S_ENT, S_EVAL, S_EXC, S_FINITE, S_MAG,
-            S_NEL, S_RMS, S_V0, S_VHA, S_VXC,
+            S_BXC, S_CHG, S_E1, S_E2, S_EHA, S_ENT, S_EVAL, S_EXC, S_FINITE,
+            S_HERM, S_MAG, S_NEL, S_ORTHO, S_RMS, S_SYM, S_V0, S_VHA, S_VXC,
         )
 
         if scf_mesh is not None:
@@ -904,6 +911,7 @@ def _run_scf_inner(
     sup = ScfSupervisor(
         cfg.control, mixer.beta, mixer.kind,
         deck_label=f"nk={nk} ns={ns} nb={nb} ng={ng}",
+        density_tol=float(p.density_tol),
     )
     _snap_every = max(1, int(getattr(cfg.control, "snapshot_every", 5)))
     _autosave_every = int(getattr(cfg.control, "autosave_every", 0))
@@ -1037,6 +1045,58 @@ def _run_scf_inner(
         # fault site: a preemption right after the autosave (soak test /
         # tests drive the resume path through this)
         faults.check("scf.autosave_kill", it)
+
+    # ---- convergence forecasting + deadline feasibility (obs/forecast.py
+    # via the supervisor): one scf_forecast event and two gauges per
+    # iteration, plus a deadline_feasibility event whenever the
+    # forecasted finish crosses control.deadline_ts in either direction.
+    _fc_warnings = 0
+    _fc_deadline_ok = None  # None until the first feasibility verdict
+    _iter_wall: list[float] = []
+    _numerics_probe = bool(getattr(cfg.control, "numerics_probe", False))
+    _numerics_every = max(
+        1, int(getattr(cfg.control, "numerics_probe_every", 10)))
+
+    def _forecast_tick(it, dt, path):
+        nonlocal _fc_warnings, _fc_deadline_ok
+        if not (sup.enabled and sup.forecast_enabled):
+            return
+        _iter_wall.append(float(dt))
+        # fault site: a deliberately wrong forecast — maximum warning with
+        # no real divergence; drives the proactive-snapshot and deadline
+        # paths and pins that a misfire alone never costs a recovery
+        if faults.armed("scf.forecast_misfire", it):
+            sup.inject_warning(1.0)
+        snap = sup.forecast_snapshot()
+        if snap is None:
+            return
+        warning = float(snap.get("warning") or 0.0)
+        if warning >= sup.forecast_warning_threshold:
+            _fc_warnings += 1
+        total = snap.get("forecast_total")
+        if total is not None:
+            _FORECAST_ITERS.set(float(total))
+        _FORECAST_WARNING.set(warning)
+        obs_events.emit("scf_forecast", it=it + 1, path=path, **{
+            k: snap.get(k) for k in (
+                "decay_rate", "forecast_remaining", "forecast_total",
+                "warning", "growth_streak")})
+        deadline = float(getattr(cfg.control, "deadline_ts", 0.0) or 0.0)
+        remaining = snap.get("forecast_remaining")
+        if deadline > 0.0 and remaining is not None and _iter_wall:
+            # median of the recent iteration walls: robust against the
+            # compile-dominated first iteration
+            tail = sorted(_iter_wall[-5:])
+            per_it = tail[len(tail) // 2]
+            eta = time.time() + per_it * float(remaining)
+            ok = bool(eta <= deadline)
+            if ok != _fc_deadline_ok:
+                obs_events.emit(
+                    "deadline_feasibility", it=it + 1, feasible=ok,
+                    eta_ts=eta, deadline_ts=deadline,
+                    forecast_remaining=remaining,
+                    sec_per_iteration=per_it)
+                _fc_deadline_ok = ok
 
     obs_events.emit(
         "run_manifest", nk=nk, ns=ns, nb=nb, ng=ng,
@@ -1591,7 +1651,7 @@ def _run_scf_inner(
                 _fu_t = time.perf_counter()
                 fused_carry, fused_out = fused.step(
                     fused_carry, acc, dm_re, dm_im, ev_dev, occ_w,
-                    entropy_sum,
+                    entropy_sum, pr, pi,
                 )
                 if _span_fence:
                     _fence(fused_out)
@@ -1647,10 +1707,14 @@ def _run_scf_inner(
             _ETOT.set(e_total)
             _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
                           path="fused", **_hbm_attr())
+            # numerics ledger: the invariants ride the existing [NUM_SCALARS]
+            # readback (dft/fused.py) — naming them here costs no transfer
+            ledger = obs_numerics.ledger_from_scalars(fused_np)
+            obs_numerics.record_ledger(ledger, it + 1, "fused")
             obs_events.emit(
                 "scf_iteration", it=it + 1, path="fused", rms=rms,
                 e_total=e_total, dt=_it_dt,
-                scalars=[float(v) for v in fused_np],
+                scalars=[float(v) for v in fused_np], ledger=ledger,
             )
             if cfg.control.verbosity >= 2:
                 mg = f" mag={mag_history[-1]:+.4f}" if polarized else ""
@@ -1660,11 +1724,15 @@ def _run_scf_inner(
             if sentinel is not None:
                 _recover(sentinel)
                 continue
-            if sup.enabled and it % _snap_every == 0:
+            _forecast_tick(it, _it_dt, "fused")
+            if sup.enabled and (it % _snap_every == 0
+                                or sup.should_snapshot()):
                 # rollback snapshot: fetch the mixed vector from the carry
                 # OUTSIDE the fused profile span (an explicit supervised
-                # transfer every snapshot_every iterations, not hidden
-                # per-iteration traffic)
+                # transfer every snapshot_every iterations — plus whenever
+                # the divergence early warning is raised, so a subsequent
+                # rollback lands on the newest trusted iterate instead of
+                # one up to snapshot_every iterations stale)
                 x_snap, _ = fused.fetch_state(fused_carry)
                 sup.snapshot(it, {
                     "x_mix": x_snap, "e_total": e_total,
@@ -1947,14 +2015,33 @@ def _run_scf_inner(
         _ETOT.set(e_total)
         _stage_record("scf.iteration", _it_dt, t0=_it_t0, it=it + 1,
                       path="host", **_hbm_attr())
+        # numpy twin of the fused on-device numerics ledger (obs/numerics.py)
+        # — same invariants from the same operands, so the fused values can
+        # be validated against this path (tests/test_fused_scf.py)
+        ledger = None
+        if pr is not None:
+            _sym_resid = (
+                float(np.max(np.abs(symmetrize_pw(ctx, rho_new) - rho_new)))
+                if do_symmetrize else 0.0
+            )
+            ledger = obs_numerics.ledger_host(
+                np.asarray(pr) + 1j * np.asarray(pi),
+                np.asarray(ctx.beta.beta_gk)
+                if ctx.beta.num_beta_total else None,
+                ctx.beta.qmat, ctx.beta.dion,
+                np.asarray(ctx.gkvec.mask, dtype=np.float64),
+                x_mix, x_new, ctx.unit_cell.omega, sym_resid=_sym_resid,
+            )
+            obs_numerics.record_ledger(ledger, it + 1, "host")
         obs_events.emit(
             "scf_iteration", it=it + 1, path="host", rms=rms,
             e_total=e_total, dt=_it_dt,
-            # host-path equivalent of the fused [16] scalar record
+            # host-path equivalent of the fused [NUM_SCALARS] scalar record
             scalars={"eval_sum": eval_sum, "vha": e["vha"], "vxc": e["vxc"],
                      "exc": e["exc"], "bxc": e["bxc"],
                      "entropy": float(entropy_sum),
                      "scf_correction": scf_correction},
+            ledger=ledger,
         )
         if cfg.control.verbosity >= 2:
             # reference per-iteration SCF line (dft_ground_state verbosity 2)
@@ -1966,6 +2053,22 @@ def _run_scf_inner(
         if sentinel is not None:
             _recover(sentinel)
             continue
+        _forecast_tick(it, _it_dt, "host")
+        # in-loop precision-headroom probes (obs/numerics.py): shadow
+        # re-execution of the post-band stages at degraded precision on
+        # the current iterate, every numerics_probe_every iterations
+        if (_numerics_probe and pr is not None
+                and (it + 1) % _numerics_every == 0):
+            _pb_t0 = time.perf_counter()
+            _stages = obs_numerics.probe_stages(
+                ctx, xc, np.asarray(pr) + 1j * np.asarray(pi), occ_np,
+                np.asarray(evals), rho_g, mag_g,
+                mixer_beta=mixer.beta, smearing=p.smearing,
+                smearing_width=float(p.smearing_width),
+            )
+            obs_numerics.emit_probe_events(_stages, it=it + 1)
+            _stage_record("scf.numerics_probe",
+                          time.perf_counter() - _pb_t0, it=it + 1)
         if sup.enabled:
             # host path: the snapshot is a cheap host copy — keep the last
             # finite post-mix state every iteration
@@ -2076,6 +2179,32 @@ def _run_scf_inner(
         "counters": dict(counters),
         "timers": timer_report(),
     }
+    # convergence-forecast summary (obs/forecast.py via the supervisor):
+    # consumed by serve/scheduler.py (deadline triage) and campaigns
+    _fc_snap = sup.forecast_snapshot()
+    result["forecast"] = {
+        "enabled": bool(sup.enabled and sup.forecast_enabled),
+        "decay_rate": _fc_snap.get("decay_rate") if _fc_snap else None,
+        "forecast_total": _fc_snap.get("forecast_total") if _fc_snap else None,
+        "forecast_remaining": (
+            _fc_snap.get("forecast_remaining") if _fc_snap else None),
+        "warning": _fc_snap.get("warning") if _fc_snap else None,
+        "warnings_total": _fc_warnings,
+        "actual_iterations": num_iter_done,
+    }
+    # end-of-run precision-headroom probe on the final iterate (both
+    # paths; the in-loop cadence above only covers the host path)
+    if _numerics_probe and num_iter_done > 0 and psi is not None:
+        _pb_t0 = time.perf_counter()
+        _stages = obs_numerics.probe_stages(
+            ctx, xc, np.asarray(psi), occ_np, np.asarray(evals),
+            rho_g, mag_g, mixer_beta=mixer.beta, smearing=p.smearing,
+            smearing_width=float(p.smearing_width),
+        )
+        obs_numerics.emit_probe_events(_stages, it=num_iter_done)
+        _stage_record("scf.numerics_probe",
+                      time.perf_counter() - _pb_t0, it=num_iter_done)
+        result["numerics"] = _stages
     _RUNS.inc(outcome="converged" if converged else "unconverged")
     obs_events.emit(
         "scf_done", converged=converged, iterations=num_iter_done,

@@ -77,6 +77,10 @@ UNCOSTED_SPANS = (
     "collective.psum_beta",
     # timeline export work itself (obs/timeline.py)
     "trace.export",
+    # precision-headroom shadow probes (obs/numerics.py): duplicate stage
+    # evaluations at reduced precision — attribution would double-count
+    # the real stages' FLOPs
+    "scf.numerics_probe",
 )
 
 
@@ -295,7 +299,9 @@ def scf_stage_costs(nk: int, ns: int, nb: int, ngk: int, nbeta: int,
         bytes=c["scf.mixing"].bytes + c["scf.potential"].bytes
         + c["scf.d_matrix"].bytes,
     )
-    c["scf.readback"] = StageCost(flops=0.0, bytes=16.0 * 16)
+    # one [NUM_SCALARS] float64 vector per iteration (dft/fused.py; the
+    # numerics-ledger invariants ride in the same record)
+    c["scf.readback"] = StageCost(flops=0.0, bytes=8.0 * 20)
     c["scf.iteration"] = StageCost(
         flops=sum(v.flops for k, v in c.items()
                   if k not in ("scf.fused_step", "scf.readback")),

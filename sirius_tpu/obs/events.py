@@ -10,9 +10,18 @@ without the DFT layer knowing it runs under serve.
 Event kinds emitted across the tree:
 
 - ``run_manifest``   — once per run_scf/run_md: deck label, task, shapes
-- ``scf_iteration``  — per SCF iteration: the [16] device scalar record
-  (dft/fused.py) or the host-path equivalents, plus rms/e_total
+- ``scf_iteration``  — per SCF iteration: the [NUM_SCALARS] device scalar
+  record (dft/fused.py) or the host-path equivalents, plus rms/e_total
+  and the named numerics ledger invariants (``ledger``)
 - ``scf_done``       — terminal SCF record: converged, iterations, energy
+- ``scf_forecast``   — per SCF iteration when forecast_enabled: decay
+  rate, iterations-to-converge forecast, early-warning score
+  (obs/forecast.py via dft/recovery.py)
+- ``deadline_feasibility`` — the forecasted finish crossing a
+  control.deadline_ts boundary in either direction (dft/scf.py; serve
+  jobs carry it per job via serve/scheduler.py)
+- ``numerics_probe`` — one record per (stage, precision) shadow probe:
+  energy_impact_ha, rel_err, clears (obs/numerics.py)
 - ``recovery``       — each ladder rung taken (dft/recovery.py)
 - ``autosave`` / ``checkpoint`` — checkpoint writes with path + iteration
 - ``md_step``        — per MD step: energies, drift, scf_iterations,

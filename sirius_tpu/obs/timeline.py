@@ -17,7 +17,11 @@ reads. This module folds them into the Chrome trace-event format
   node spanning its RUNNING->terminal interval, and "s"/"f" flow arrows
   along the handoff edges;
 - "C" counter tracks for the per-iteration HBM high-water samples that
-  dft/scf.py attaches to scf.iteration spans;
+  dft/scf.py attaches to scf.iteration spans, and for the numerics
+  observatory: the SCF residual and on-device ledger invariants
+  (scf_iteration events), the decay-rate/forecast/early-warning series
+  (scf_forecast events) and the per-stage precision-headroom probe
+  impacts (numerics_probe events) each render as counter series;
 - optionally, the jax.profiler device traces (``*.trace.json.gz``
   written by obs/trace.py captures) merged in with offset pids — one
   track per device, stitched under the same timeline (best-effort: the
@@ -110,6 +114,38 @@ def build_chrome_trace(records: list[dict], trace_id: str | None = None,
                 "ts": int(float(r["ts"]) * _US), "s": "t",
                 "pid": pid, "tid": tid, "args": args,
             })
+            # numerics observatory counter tracks (obs/numerics.py +
+            # obs/forecast.py): residual, ledger invariants, forecast and
+            # probe headroom render as Perfetto counter series next to
+            # the hbm_peak_bytes track above
+            cts = int(float(r["ts"]) * _US)
+            if kind == "scf_iteration":
+                if isinstance(r.get("rms"), (int, float)):
+                    ev.append({"name": "scf_residual", "ph": "C",
+                               "ts": cts, "pid": pid, "tid": tid,
+                               "args": {"rms": float(r["rms"])}})
+                led = r.get("ledger")
+                if isinstance(led, dict) and led:
+                    ev.append({
+                        "name": "numerics_ledger", "ph": "C", "ts": cts,
+                        "pid": pid, "tid": tid,
+                        "args": {k: float(v) for k, v in led.items()
+                                 if isinstance(v, (int, float))}})
+            elif kind == "scf_forecast":
+                fc = {k: float(r[k]) for k in
+                      ("decay_rate", "forecast_remaining", "warning")
+                      if isinstance(r.get(k), (int, float))}
+                if fc:
+                    ev.append({"name": "scf_forecast", "ph": "C",
+                               "ts": cts, "pid": pid, "tid": tid,
+                               "args": fc})
+            elif kind == "numerics_probe":
+                if isinstance(r.get("energy_impact_ha"), (int, float)):
+                    series = f"{r.get('stage')}:{r.get('prec')}"
+                    ev.append({
+                        "name": "numerics_headroom", "ph": "C", "ts": cts,
+                        "pid": pid, "tid": tid,
+                        "args": {series: float(r["energy_impact_ha"])}})
 
     ev.extend(_campaign_tracks(records, campaign_id))
 

@@ -74,6 +74,11 @@ _BACKOFF = obs_metrics.REGISTRY.histogram(
 _NODE_ITERS = obs_metrics.REGISTRY.counter(
     "campaign_node_scf_iterations_total",
     "SCF iterations spent on campaign nodes, by warm/cold handoff")
+# same family run_scf updates mid-run (dft/scf.py); serve re-publishes the
+# terminal forecast per slice so dashboards see it after the job finishes
+_FORECAST_ITERS = obs_metrics.REGISTRY.gauge(
+    "scf_forecast_iterations",
+    "forecasted total SCF iterations to convergence (obs/forecast.py)")
 
 # SimulationContext building for synthetic decks monkeypatches
 # UnitCell.from_config (testing.py idiom); serialize every context build
@@ -307,6 +312,11 @@ class SliceScheduler:
                 cfg.control.autosave_every = self.autosave_every
             if not cfg.control.autosave_keep:
                 cfg.control.autosave_keep = self.autosave_keep
+            if job.deadline is not None and not cfg.control.deadline_ts:
+                # forecast-driven deadline triage: run_scf emits
+                # deadline_feasibility events against this bound as its
+                # iterations-to-converge forecast evolves (obs/forecast.py)
+                cfg.control.deadline_ts = float(job.deadline)
             ctx = build_job_context(cfg, job.base_dir)
             key = cache_mod.bucket_key(cfg, ctx)
             warm = self.cache.note_job(key)
@@ -392,7 +402,12 @@ class SliceScheduler:
                 "compiled_executables": compiled,
                 "warm_start": guess is not None,
                 "handoff": handoff_mode,
+                "forecast": result.get("forecast"),
             }
+            _fc = result.get("forecast") or {}
+            if _fc.get("forecast_total") is not None:
+                _FORECAST_ITERS.set(float(_fc["forecast_total"]),
+                                    slice=str(slice_idx))
             if self._stale(job, epoch):
                 return
             if job.handoff_out:

@@ -13,6 +13,12 @@ Sites currently wired:
   scf.potential      corrupt the generated effective potential
   scf.evals          corrupt the band-solve eigenvalues
   scf.band_stagnate  force the band-solve health check to report stagnation
+  scf.forecast_misfire
+                     force the convergence forecaster's early-warning
+                     score to maximum at one iteration (a deliberately
+                     wrong forecast): drives the proactive-snapshot and
+                     deadline-infeasibility paths deterministically, and
+                     pins that a misfire alone never costs a recovery
   scf.autosave_kill  die (SimulatedKill or hard exit) right after an autosave
   md.autosave_kill   die right after an MD trajectory checkpoint (md/driver)
   checkpoint.before_rename  die inside save_state between the temp-file
@@ -74,6 +80,7 @@ KNOWN_SITES = (
     "scf.potential",
     "scf.evals",
     "scf.band_stagnate",
+    "scf.forecast_misfire",
     "scf.autosave_kill",
     "md.autosave_kill",
     "checkpoint.before_rename",

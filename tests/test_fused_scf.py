@@ -103,6 +103,43 @@ def test_fused_no_host_transfers():
     assert np.isfinite(res["energy"]["total"])
 
 
+def test_fused_ledger_rides_single_readback(tmp_path):
+    """The numerics ledger (obs/numerics.py) widens the fused scalar
+    record to [NUM_SCALARS]; it must still arrive as ONE vector per
+    iteration (the transfer-guard test above pins the no-extra-transfers
+    half), with every invariant finite, and its values must agree with
+    the host path's numpy twin at the first iteration — where both paths
+    see the identical band solve."""
+    from sirius_tpu.dft.fused import NUM_SCALARS
+    from sirius_tpu.obs import events as obs_events
+
+    deck = dict(
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+        ultrasoft=True, use_symmetry=False,
+        extra_params={"num_dft_iter": 3, "density_tol": 1e-12,
+                      "energy_tol": 1e-14},
+    )
+    try:
+        obs_events.configure(str(tmp_path / "ev_dev.jsonl"))
+        _run("auto", **deck)
+        obs_events.configure(str(tmp_path / "ev_host.jsonl"))
+        _run("off", **deck)
+    finally:
+        obs_events.close()
+    dev = obs_events.read_events(str(tmp_path / "ev_dev.jsonl"),
+                                 kind="scf_iteration")
+    host = obs_events.read_events(str(tmp_path / "ev_host.jsonl"),
+                                  kind="scf_iteration")
+    assert dev and host
+    for r in dev:
+        assert len(r["scalars"]) == NUM_SCALARS
+        assert set(r["ledger"]) == {"ortho", "charge", "sym", "herm"}
+        assert all(np.isfinite(v) for v in r["ledger"].values())
+    l_dev, l_host = dev[0]["ledger"], host[0]["ledger"]
+    for k in l_dev:
+        assert abs(l_dev[k] - l_host[k]) <= 1e-12, (k, l_dev, l_host)
+
+
 def test_fused_respects_off_switch():
     """control.device_scf = false must keep the host path (no fused span)."""
     from sirius_tpu.utils.profiler import reset_timers, timer_report

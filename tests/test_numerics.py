@@ -1,0 +1,208 @@
+"""Numerics observatory (obs/numerics.py + obs/forecast.py): decay-rate
+fitting, iterations-to-converge forecasts scored against recorded SCF
+event streams (tests/data/*.jsonl), the precision-headroom probe harness
+on a live iterate, and the baseline compare gate."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from sirius_tpu.obs import forecast as fc
+from sirius_tpu.obs import numerics as num
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+# ---- fit_decay / ConvergenceForecaster ---------------------------------
+
+
+def test_fit_decay_recovers_geometric_rate():
+    vals = [1e-1 * 0.5 ** i for i in range(8)]
+    assert abs(fc.fit_decay(vals) - 0.5) < 1e-12
+
+
+def test_fit_decay_degenerate_inputs():
+    assert math.isnan(fc.fit_decay([1e-3]))
+    assert math.isnan(fc.fit_decay([0.0, -1.0, float("nan")]))
+    assert math.isnan(fc.fit_decay([]))
+
+
+def test_forecaster_remaining_exact_on_clean_decay():
+    # tol off the exact decade boundary: ceil() at a boundary would make
+    # the expected count depend on float rounding in the fit
+    f = fc.ConvergenceForecaster(2e-8)
+    snap = None
+    for i in range(6):
+        snap = f.update(i + 1, 1e-2 * 0.1 ** i)
+    # at rms 1e-7 with rate 0.1: ~0.7 decades to go -> one iteration
+    assert snap["decay_rate"] == pytest.approx(0.1, rel=1e-9)
+    assert snap["forecast_remaining"] == 1
+    assert snap["forecast_total"] == 7
+    assert snap["warning"] == 0.0
+
+
+def test_forecaster_warning_on_sustained_growth():
+    f = fc.ConvergenceForecaster(1e-8)
+    snap = None
+    for i, r in enumerate([1e-4, 1e-3, 1e-2, 1e-1]):
+        snap = f.update(i + 1, r)
+    assert snap["warning"] >= 0.5
+    assert snap["forecast_remaining"] is None
+
+
+def test_forecaster_trusts_nothing_early():
+    """Before min_history samples the score is pinned to 1.0: a trajectory
+    with no contraction evidence has not earned trust (this is what gives
+    the early warning its lead time over iteration-3 fault injections)."""
+    f = fc.ConvergenceForecaster(1e-8, min_history=3)
+    assert f.update(1, 1e-3)["warning"] == 1.0
+    assert f.update(2, 9e-4)["warning"] == 1.0
+    assert f.update(3, 8e-4)["warning"] < 0.5
+
+
+def test_forecaster_reset_clears_trajectory():
+    f = fc.ConvergenceForecaster(1e-8)
+    for i in range(5):
+        f.update(i + 1, 1e-2 * 0.5 ** i)
+    f.reset()
+    snap = f.snapshot()
+    assert snap["n_history"] == 0 and snap["it"] is None
+    assert snap["warning"] == 1.0
+
+
+# ---- replay over recorded runs (ISSUE acceptance) ----------------------
+
+# recorded with tools/record_numerics_fixtures.py: the tiny silicon deck
+# of tests/test_recovery.py on the host and fused paths, scf_iteration
+# events only, density_tol 5e-9
+FIXTURES = ("scf_host_small.jsonl", "scf_fused_small.jsonl")
+FIXTURE_TOL = 5e-9
+
+
+def _load(name):
+    recs = []
+    with open(os.path.join(DATA, name)) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return [r for r in recs if r.get("kind") == "scf_iteration"]
+
+
+@pytest.mark.parametrize("name", FIXTURES)
+def test_forecast_accuracy_on_recorded_runs(name):
+    """Median |forecast_total - actual| <= 20% of actual from iteration 5
+    onward, scored on checked-in small-tier runs (ISSUE acceptance)."""
+    recs = _load(name)
+    actual = fc.converged_iteration(recs, FIXTURE_TOL)
+    assert actual is not None and actual > 6, (
+        "fixture must converge late enough to leave a forecastable window")
+    snaps = fc.replay(recs, FIXTURE_TOL)
+    errs = [abs(s["forecast_total"] - actual) / actual
+            for s in snaps
+            if s["it"] >= 5 and s["it"] < actual
+            and s["forecast_total"] is not None]
+    assert errs, "no forecastable window in the recorded run"
+    assert float(np.median(errs)) <= 0.20
+
+
+@pytest.mark.parametrize("name", FIXTURES)
+def test_recorded_runs_carry_ledger(name):
+    """The checked-in event streams were recorded after the ledger landed:
+    every iteration names the four invariants, all finite."""
+    recs = _load(name)
+    assert recs
+    for r in recs:
+        led = r.get("ledger")
+        assert set(led) == set(num.LEDGER_KEYS)
+        assert all(math.isfinite(float(v)) for v in led.values())
+
+
+# ---- probe harness on a live iterate -----------------------------------
+
+
+def test_probe_stages_live():
+    """End-to-end probe on a tiny converged iterate: at least five stages
+    scored for both precisions (the ISSUE floor), impacts finite and
+    non-negative, and bf16 never beats fp32 (narrower mantissa) on more
+    than the one stage whose fp32 probe runs true reduced arithmetic."""
+    from sirius_tpu.dft.scf import run_scf
+    from sirius_tpu.dft.xc import XCFunctional
+    from sirius_tpu.testing import synthetic_silicon_context
+
+    ctx = synthetic_silicon_context(
+        gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+        ultrasoft=True, use_symmetry=False,
+        extra_params={"num_dft_iter": 8, "density_tol": 1e-12,
+                      "energy_tol": 1e-14},
+    )
+    ctx.cfg.control.device_scf = "off"
+    res = run_scf(ctx.cfg, ctx=ctx, keep_state=True)
+    st = res["_state"]
+    xc = XCFunctional(ctx.cfg.parameters.xc_functionals)
+    stages = num.probe_stages(
+        ctx, xc, st["psi"], np.asarray(res["band_occupancies"]),
+        np.asarray(res["band_energies"]), st["rho_g"], st.get("mag_g"))
+    assert len(stages) >= 5
+    for ent in stages.values():
+        for prec in num.PRECISIONS:
+            imp = ent[prec]["energy_impact_ha"]
+            assert math.isfinite(imp) and imp >= 0.0
+            assert math.isfinite(ent[prec]["rel_err"])
+        assert isinstance(ent["clears_fp32"], bool)
+        assert isinstance(ent["clears_bf16"], bool)
+    worse = sum(
+        stages[s]["bf16"]["energy_impact_ha"]
+        >= stages[s]["fp32"]["energy_impact_ha"] for s in stages)
+    assert worse >= len(stages) - 1
+
+
+# ---- baseline compare gate ---------------------------------------------
+
+
+def _entry(impact32, impact16, clears32=True, clears16=True):
+    return {"tiers": {"small": {"stages": {"scf.mixing": {
+        "fp32": {"energy_impact_ha": impact32, "rel_err": 0.0},
+        "bf16": {"energy_impact_ha": impact16, "rel_err": 0.0},
+        "clears_fp32": clears32, "clears_bf16": clears16}}}}}
+
+
+def test_compare_entries_pass_and_noise_floor():
+    # both sides under the noise floor compare equal no matter how their
+    # last digits moved; a 0.3-decade drift is within tolerance
+    base = _entry(1e-16, 1e-9)
+    cur = _entry(5e-15, 2e-9)
+    assert num.compare_entries(base, cur) == []
+
+
+def test_compare_entries_flags_clears_flip():
+    base = _entry(1e-10, 1e-9)
+    cur = _entry(1e-7, 1e-9, clears32=False)
+    regs = num.compare_entries(base, cur)
+    assert [r["kind"] for r in regs] == ["clears_flip"]
+    assert regs[0]["prec"] == "fp32"
+
+
+def test_compare_entries_flags_decade_growth():
+    base = _entry(1e-12, 1e-9)
+    cur = _entry(1e-10, 1e-9)
+    regs = num.compare_entries(base, cur)
+    assert [r["kind"] for r in regs] == ["error_growth"]
+    assert regs[0]["decades"] == pytest.approx(2.0)
+
+
+def test_compare_entries_flags_missing_stage():
+    base = _entry(1e-12, 1e-9)
+    cur = {"tiers": {"small": {"stages": {}}}}
+    regs = num.compare_entries(base, cur)
+    assert [r["kind"] for r in regs] == ["missing"]
+
+
+def test_load_baseline_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"schema": 99, "series": [{}]}))
+    with pytest.raises(SystemExit):
+        num.load_baseline(str(p))

@@ -45,17 +45,41 @@ def e_ref():
     return r["energy"]["total"]
 
 
-def test_nan_density_recovers_host(e_ref):
+def _assert_early_warning(path, inj_it, site):
+    """ISSUE acceptance: the forecaster's early-warning score must cross
+    the backoff threshold at least two iterations before the injected NaN
+    turns fatal — that lead time is what makes the proactive snapshot land
+    on a trusted iterate."""
+    from sirius_tpu.obs import events as obs_events
+
+    fcast = obs_events.read_events(path, kind="scf_forecast")
+    assert fcast, "scf_forecast events missing"
+    warn_its = [e["it"] for e in fcast if e["warning"] >= 0.5]
+    assert warn_its, f"no early warning before {site}@{inj_it}"
+    # events are 1-based; the fault fires at 0-based inj_it
+    assert min(warn_its) <= inj_it + 1 - 2, (site, warn_its)
+
+
+def test_nan_density_recovers_host(e_ref, tmp_path):
     """A NaN injected into the accumulated density at iteration 3 must not
     raise: the supervisor rolls back, flushes the mixer history, and the
-    run converges to the unperturbed energy (ISSUE acceptance bar)."""
-    r = _run("off", plan=[("scf.density", 3, "nan")])
+    run converges to the unperturbed energy (ISSUE acceptance bar) — with
+    the divergence early warning on record >=2 iterations beforehand."""
+    from sirius_tpu.obs import events as obs_events
+
+    ev = str(tmp_path / "ev.jsonl")
+    try:
+        obs_events.configure(ev)
+        r = _run("off", plan=[("scf.density", 3, "nan")])
+    finally:
+        obs_events.close()
     assert r["converged"]
     rec = r["recovery"]
     assert rec["recoveries"] == 1
     assert rec["ladder_history"][0]["action"] == "flush_history"
     assert rec["ladder_history"][0]["sentinel"] == "nonfinite_fields"
     assert abs(r["energy"]["total"] - e_ref) < 1e-8
+    _assert_early_warning(ev, 3, "scf.density")
 
 
 def test_nan_density_recovers_fused(e_ref):
@@ -78,11 +102,19 @@ def test_nan_potential_recovers_host(e_ref):
     assert abs(r["energy"]["total"] - e_ref) < 1e-8
 
 
-def test_nan_evals_recovers_host(e_ref):
-    r = _run("off", plan=[("scf.evals", 2, "nan")])
+def test_nan_evals_recovers_host(e_ref, tmp_path):
+    from sirius_tpu.obs import events as obs_events
+
+    ev = str(tmp_path / "ev.jsonl")
+    try:
+        obs_events.configure(ev)
+        r = _run("off", plan=[("scf.evals", 2, "nan")])
+    finally:
+        obs_events.close()
     assert r["converged"]
     assert r["recovery"]["recoveries"] == 1
     assert abs(r["energy"]["total"] - e_ref) < 1e-8
+    _assert_early_warning(ev, 2, "scf.evals")
 
 
 def test_ladder_escalates_to_host_fallback(e_ref):
@@ -152,6 +184,53 @@ def test_band_stagnate_exact_diag_fallback(e_ref):
     assert ("scf.band_stagnate", 2, "flag") in faults.fired()
     assert r["converged"]
     assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_proactive_snapshot_beats_cadence_fused(e_ref):
+    """With a sparse snapshot cadence on the fused path, the early
+    warning forces an extra snapshot so the rollback after an injected
+    iteration-3 NaN lands on iteration 2 — not on the stale cadence
+    snapshot from iteration 1."""
+    r = _run("auto", plan=[("scf.density", 3, "nan")], snapshot_every=5)
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 1
+    # warning is pinned to 1.0 while history < min_history, so the
+    # supervisor snapshots at it=1 (0-based) beyond the cadence (it=0)
+    assert rec["ladder_history"][0]["rolled_back_to"] == 1
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_forecast_misfire_costs_no_recovery(e_ref):
+    """A deliberately wrong forecast (maximum warning with a healthy
+    trajectory) must only cost an extra snapshot — never a recovery."""
+    r = _run("off", plan=[("scf.forecast_misfire", 4, "flag")])
+    assert ("scf.forecast_misfire", 4, "flag") in faults.fired()
+    assert r["converged"]
+    assert r["recovery"]["recoveries"] == 0
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_forecast_divergence_sentinel_unit():
+    """The forecast sentinel fires on sustained warning + order-of-
+    magnitude growth, well before the slower rms_divergence streak."""
+
+    class Ctl:
+        scf_supervision = True
+        max_recoveries = 3
+        rms_divergence_iters = 8  # keep the rms sentinel out of the way
+        energy_blowup_tol = 1e9
+        diag_dump = ""
+        forecast_backoff_iters = 3
+
+    sup = ScfSupervisor(Ctl(), 0.7, "anderson", density_tol=1e-9)
+    fired = [sup.observe(i, 1e-4 * 3.0 ** i, -1.0) for i in range(6)]
+    assert "forecast_divergence" in fired
+    assert "rms_divergence" not in fired
+    # a healthy contraction never trips it
+    sup2 = ScfSupervisor(Ctl(), 0.7, "anderson", density_tol=1e-9)
+    for i in range(10):
+        assert sup2.observe(i, 1e-2 * 0.5 ** i, -1.0) is None
 
 
 def test_rms_divergence_sentinel_unit():

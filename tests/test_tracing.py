@@ -274,6 +274,40 @@ def test_chrome_trace_structure_and_validation():
     assert any("ts" in p for p in probs) and any("dur" in p for p in probs)
 
 
+def test_numerics_counter_tracks_render_and_validate():
+    """Numerics-observatory events (obs/numerics.py + obs/forecast.py)
+    render as Perfetto counter series — residual + ledger invariants,
+    forecast decay rate/warning, and per-stage probe headroom — and the
+    resulting document clears validate_chrome_trace."""
+    recs = [
+        {"kind": "scf_iteration", "ts": 10.0, "pid": 7, "thread": "main",
+         "it": 1, "rms": 1e-3, "e_total": -7.5,
+         "ledger": {"ortho": 1e-15, "charge": 2e-13, "sym": 0.0,
+                    "herm": 3e-16}},
+        {"kind": "scf_forecast", "ts": 10.1, "pid": 7, "thread": "main",
+         "it": 1, "path": "host", "decay_rate": 0.4,
+         "forecast_remaining": 6, "forecast_total": 7, "warning": 0.0,
+         "growth_streak": 0},
+        {"kind": "numerics_probe", "ts": 10.2, "pid": 7, "thread": "main",
+         "stage": "scf.mixing", "prec": "bf16", "energy_impact_ha": 3e-4,
+         "rel_err": 1e-3, "clears": False},
+    ]
+    doc = timeline.build_chrome_trace(recs)
+    assert timeline.validate_chrome_trace(doc) == []
+    counters = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "C"}
+    assert counters["scf_residual"]["args"] == {"rms": 1e-3}
+    assert counters["numerics_ledger"]["args"]["charge"] == 2e-13
+    assert set(counters["numerics_ledger"]["args"]) == {
+        "ortho", "charge", "sym", "herm"}
+    assert counters["scf_forecast"]["args"]["decay_rate"] == 0.4
+    assert counters["scf_forecast"]["args"]["warning"] == 0.0
+    assert counters["numerics_headroom"]["args"] == {"scf.mixing:bf16": 3e-4}
+    # every numerics record still gets its instant marker alongside
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert {e["name"] for e in instants} == {
+        "scf_iteration", "scf_forecast", "numerics_probe"}
+
+
 def test_trace_id_filter_selects_one_trace():
     recs = _synthetic_campaign_records()
     recs.append({"kind": "span", "name": "scf.iteration", "t0": 0.0,
