@@ -7,9 +7,12 @@ Typical use::
     sirius-lint --baseline LINT_BASELINE.json    # CI mode: new findings only
     sirius-lint --write-baseline LINT_BASELINE.json   # accept current state
     sirius-lint --list-rules                     # rule catalog
+    sirius-lint --sarif lint.sarif               # SARIF 2.1.0 for review UIs
+    sirius-lint --check-suppressions --strict    # stale-suppression audit
+    sirius-lint --report sharding                # mesh/axis inventory (stdout)
 
-Exit codes: 0 = clean (or nothing new vs the baseline), 1 = findings,
-2 = unparseable inputs.
+Exit codes: 0 = clean (or nothing new vs the baseline), 1 = findings
+(or, with --strict, stale suppressions), 2 = unparseable inputs.
 """
 
 from __future__ import annotations
@@ -47,7 +50,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="sirius-lint",
         description="JAX-aware static analysis for the sirius_tpu tree "
                     "(jit purity, serve lock discipline, registry "
-                    "consistency)")
+                    "consistency, recompile hazards, transfer budgets, "
+                    "sharding consistency)")
     p.add_argument("paths", nargs="*",
                    help=f"files/directories to lint (default: "
                         f"{' '.join(DEFAULT_SCAN)} under --root)")
@@ -61,7 +65,19 @@ def main(argv: list[str] | None = None) -> int:
                         "(preserves justifications for kept entries)")
     p.add_argument("--report", default=None, metavar="PATH",
                    help="write the full findings report as JSON (CI "
-                        "artifact)")
+                        "artifact); the literal value `sharding` prints "
+                        "the per-driver mesh/axis inventory to stdout "
+                        "instead")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="write findings as SARIF 2.1.0 (code-review "
+                        "annotation format)")
+    p.add_argument("--check-suppressions", action="store_true",
+                   help="audit `# sirius-lint: disable=` comments that "
+                        "silenced nothing (fixed violations or typo'd "
+                        "rule names)")
+    p.add_argument("--strict", action="store_true",
+                   help="with --check-suppressions: stale suppressions "
+                        "fail the run")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule-name filter")
     p.add_argument("--list-rules", action="store_true",
@@ -95,6 +111,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     engine = LintEngine(root, paths=paths, rules=rules)
+
+    if args.report == "sharding":
+        from sirius_tpu.analysis.shardrules import sharding_inventory
+
+        json.dump(sharding_inventory(engine.project), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 2 if engine.project.errors else 0
+
     findings = engine.run()
     for err in engine.project.errors:
         print(f"sirius-lint: parse error: {err}", file=sys.stderr)
@@ -112,6 +136,28 @@ def main(argv: list[str] | None = None) -> int:
         baseline = load_baseline(args.baseline)
         shown = new_findings(findings, baseline)
 
+    stale = []
+    if args.check_suppressions:
+        if args.rules:
+            # a partial rule set can't tell "never fired" from "rule not
+            # run"; the audit is only meaningful against the full catalog
+            print("sirius-lint: --check-suppressions requires the full "
+                  "rule catalog; drop --rules", file=sys.stderr)
+            return 2
+        stale = engine.stale_suppressions()
+        for s in stale:
+            print(f"{s['path']}:{s['line']}: stale suppression "
+                  f"[{s['rule']}] ({s['reason']}): {s['text']}")
+
+    if args.sarif:
+        from sirius_tpu.analysis.sarif import to_sarif
+
+        doc = to_sarif(findings, rules,
+                       new=shown if args.baseline else None, root=root)
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+
     if args.report:
         report = {
             "root": root,
@@ -121,6 +167,7 @@ def main(argv: list[str] | None = None) -> int:
             "new_findings": [f.to_dict() for f in shown],
             "baselined": len(findings) - len(shown),
             "suppressed_inline": engine.suppressed_count,
+            "stale_suppressions": stale,
         }
         with open(args.report, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=1)
@@ -135,10 +182,16 @@ def main(argv: list[str] | None = None) -> int:
         summary += f" ({len(findings) - len(shown)} baselined)"
     if engine.suppressed_count:
         summary += f" ({engine.suppressed_count} suppressed inline)"
+    if args.check_suppressions:
+        summary += f" ({len(stale)} stale suppression(s))"
     print(summary)
     if engine.project.errors:
         return 2
-    return 1 if shown else 0
+    if shown:
+        return 1
+    if args.strict and stale:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

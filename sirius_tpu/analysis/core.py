@@ -14,11 +14,16 @@ Suppression grammar (comments anywhere on the offending line)::
     # sirius-lint: disable-file=lock-order-cycle   (anywhere in the file)
     y = bad()          # sirius-lint: disable=*    (every rule, this line)
 
-Baseline: findings are fingerprinted by ``(rule, path, source-line
-text)`` — stable across unrelated edits that shift line numbers — and
+Baseline: findings are fingerprinted by ``(rule, enclosing qualname,
+whitespace-normalized source-line text)`` — stable across unrelated
+edits that shift line numbers AND across file renames (the path is
+advisory metadata on the baseline entry, not part of the key) — and
 compared as multisets, so CI fails only when a fingerprint's count
 *grows* (a genuinely new violation), never on pre-existing, justified
-ones.
+ones. ``write_baseline`` migrates pre-rename baselines in place:
+justifications are carried over by fingerprint first, then by
+``(rule, normalized text)`` for entries whose fingerprint scheme (or
+enclosing file) changed.
 """
 
 from __future__ import annotations
@@ -40,26 +45,36 @@ _SUPPRESS_RE = re.compile(
 # findings
 
 
+def normalize_text(text: str) -> str:
+    """Whitespace-collapsed source line: the fingerprint's text key."""
+    return " ".join(text.split())
+
+
 @dataclasses.dataclass
 class Finding:
     rule: str
-    path: str  # posix relpath from the scan root
+    path: str  # posix relpath from the scan root (advisory, not keyed)
     line: int
     col: int
     message: str
     text: str = ""  # stripped source line (fingerprint input)
+    qualname: str = "<module>"  # enclosing function/method qualname
 
     @property
     def fingerprint(self) -> str:
+        """Keyed on (rule, enclosing qualname, normalized text) so a file
+        rename — or a pure reformat — does not orphan baseline entries;
+        the path rides along as advisory metadata only."""
         h = hashlib.sha1(
-            f"{self.rule}|{self.path}|{self.text}".encode()).hexdigest()
+            f"{self.rule}|{self.qualname}|{normalize_text(self.text)}"
+            .encode()).hexdigest()
         return h[:16]
 
     def to_dict(self) -> dict:
         return {
             "rule": self.rule, "path": self.path, "line": self.line,
             "col": self.col, "message": self.message, "text": self.text,
-            "fingerprint": self.fingerprint,
+            "qualname": self.qualname, "fingerprint": self.fingerprint,
         }
 
     def __str__(self) -> str:
@@ -112,6 +127,9 @@ class FileContext:
         self.tree = ast.parse(source, filename=path)
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
+        # every suppression token as written: (comment line, rule, file?)
+        # — the stale-suppression audit diffs this against what fired
+        self.suppression_records: list[tuple[int, str, bool]] = []
         self._scan_suppressions()
 
     def _scan_suppressions(self) -> None:
@@ -124,7 +142,11 @@ class FileContext:
                 if not m:
                     continue
                 rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
-                if m.group(1) == "disable-file":
+                file_level = m.group(1) == "disable-file"
+                for r in sorted(rules):
+                    self.suppression_records.append(
+                        (tok.start[0], r, file_level))
+                if file_level:
                     self.file_suppressions |= rules
                 else:
                     self.line_suppressions.setdefault(
@@ -137,6 +159,18 @@ class FileContext:
             return True
         on_line = self.line_suppressions.get(line, ())
         return rule in on_line or "*" in on_line
+
+    def matching_suppressions(self, rule: str, line: int):
+        """The suppression records a (rule, line) finding is silenced by,
+        as (comment_line, rule_token, file_level) keys."""
+        out = []
+        for tok in (rule, "*"):
+            if tok in self.file_suppressions:
+                out.extend(r for r in self.suppression_records
+                           if r[1] == tok and r[2])
+            if tok in self.line_suppressions.get(line, ()):
+                out.append((line, tok, False))
+        return out
 
     def line_text(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -196,6 +230,7 @@ class ProjectIndex:
     def __init__(self, root: str, paths: list[str]):
         self.root = os.path.abspath(root)
         self.modules: dict[str, ModuleInfo] = {}
+        self.by_relpath: dict[str, ModuleInfo] = {}
         self.files: list[FileContext] = []
         self.errors: list[str] = []
         for p in paths:
@@ -224,6 +259,7 @@ class ProjectIndex:
             return
         mi = ModuleInfo(self._module_name(relpath), fctx)
         self.modules[mi.name] = mi
+        self.by_relpath[fctx.relpath] = mi
         self.files.append(fctx)
         pkg = mi.name.rsplit(".", 1)[0] if "." in mi.name else ""
         for node in ast.walk(fctx.tree):
@@ -395,14 +431,36 @@ class ProjectIndex:
 
     # -- findings ----------------------------------------------------------
 
+    def qualname_at(self, fctx: FileContext, line: int) -> str:
+        """Qualname of the innermost indexed function enclosing ``line``
+        (``<module>`` for top-level code) — the rename-stable fingerprint
+        anchor."""
+        mi = self.by_relpath.get(fctx.relpath)
+        if mi is None:
+            return "<module>"
+        best = None
+        for fi in mi.functions.values():
+            lo = getattr(fi.node, "lineno", None)
+            hi = getattr(fi.node, "end_lineno", None)
+            if lo is None or hi is None or not (lo <= line <= hi):
+                continue
+            if best is None or lo > best[0]:
+                best = (lo, fi.qualname)
+        return best[1] if best else "<module>"
+
     def finding(self, rule: str, fi_or_fctx, node: ast.AST | None,
                 message: str) -> Finding:
         fctx = (fi_or_fctx.module.fctx
                 if isinstance(fi_or_fctx, FunctionInfo) else fi_or_fctx)
         line = getattr(node, "lineno", 1) if node is not None else 1
         col = getattr(node, "col_offset", 0) if node is not None else 0
+        if isinstance(fi_or_fctx, FunctionInfo):
+            qualname = fi_or_fctx.qualname
+        else:
+            qualname = self.qualname_at(fctx, line)
         return Finding(rule=rule, path=fctx.relpath, line=line, col=col,
-                       message=message, text=fctx.line_text(line))
+                       message=message, text=fctx.line_text(line),
+                       qualname=qualname)
 
 
 # ---------------------------------------------------------------------------
@@ -410,13 +468,21 @@ class ProjectIndex:
 
 
 def all_rules() -> list:
-    from sirius_tpu.analysis import jaxrules, lockrules, registryrules
+    from sirius_tpu.analysis import (
+        compilerules,
+        jaxrules,
+        lockrules,
+        registryrules,
+        shardrules,
+        transferrules,
+    )
 
-    return list(jaxrules.RULES) + list(lockrules.RULES) + list(
-        registryrules.RULES)
+    return (list(jaxrules.RULES) + list(lockrules.RULES)
+            + list(registryrules.RULES) + list(compilerules.RULES)
+            + list(transferrules.RULES) + list(shardrules.RULES))
 
 
-DEFAULT_SCAN = ("sirius_tpu", "tools", "bench.py")
+DEFAULT_SCAN = ("sirius_tpu", "tools", "tests", "bench.py")
 _SKIP_DIRS = {"__pycache__", ".git", "csrc", ".github"}
 
 
@@ -444,6 +510,10 @@ class LintEngine:
         self.rules = rules if rules is not None else all_rules()
         self.registry = registry  # RegistryConfig override (tests)
         self.suppressed_count = 0
+        # (relpath, comment_line, rule_token, file_level) records that
+        # actually silenced a finding in the last run()
+        self.used_suppressions: set[tuple] = set()
+        self._ran = False
 
     def run(self) -> list[Finding]:
         findings: list[Finding] = []
@@ -462,10 +532,37 @@ class LintEngine:
                 fctx = by_path.get(f.path)
                 if fctx is not None and fctx.suppressed(f.rule, f.line):
                     self.suppressed_count += 1
+                    for rec in fctx.matching_suppressions(f.rule, f.line):
+                        self.used_suppressions.add((fctx.relpath, *rec))
                     continue
                 findings.append(f)
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        self._ran = True
         return findings
+
+    def stale_suppressions(self) -> list[dict]:
+        """Suppression comments that silenced nothing in the last run():
+        either the violation was fixed (the comment is dead weight hiding
+        future regressions) or the rule name is a typo and the comment
+        never worked. Only meaningful after run() with the full rule set —
+        the CLI guards the partial --rules case."""
+        assert self._ran, "run() first"
+        known = {r.name for r in self.rules}
+        out = []
+        for fctx in self.project.files:
+            for line, rule, file_level in fctx.suppression_records:
+                key = (fctx.relpath, line, rule, file_level)
+                if key in self.used_suppressions:
+                    continue
+                reason = ("never fired" if rule == "*" or rule in known
+                          else "unknown rule")
+                out.append({
+                    "path": fctx.relpath, "line": line, "rule": rule,
+                    "file_level": file_level, "reason": reason,
+                    "text": fctx.line_text(line),
+                })
+        out.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -484,15 +581,26 @@ def load_baseline(path: str) -> dict:
 def write_baseline(path: str, findings: list[Finding],
                    old: dict | None = None) -> dict:
     """Aggregate findings into a baseline file, preserving justifications
-    from the previous baseline for fingerprints that persist."""
+    from the previous baseline for fingerprints that persist. Entries
+    whose fingerprint changed (scheme migration, function rename) fall
+    back to a (rule, normalized text) match so justifications survive."""
     old = old or {}
+    by_text = {(e.get("rule"), normalize_text(e.get("text", ""))): e
+               for e in old.values() if e.get("justification")}
+
+    def _justification(f: Finding) -> str:
+        hit = old.get(f.fingerprint)
+        if hit and hit.get("justification"):
+            return hit["justification"]
+        hit = by_text.get((f.rule, normalize_text(f.text)))
+        return hit["justification"] if hit else ""
+
     agg: dict[str, dict] = {}
     for f in findings:
         e = agg.setdefault(f.fingerprint, {
             "fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
-            "text": f.text, "count": 0,
-            "justification": old.get(f.fingerprint, {}).get(
-                "justification", ""),
+            "qualname": f.qualname, "text": f.text, "count": 0,
+            "justification": _justification(f),
         })
         e["count"] += 1
     data = {

@@ -1,11 +1,15 @@
 """Registry-consistency rules.
 
-Three registries keep names honest across subsystem boundaries:
+Five registries keep names honest across subsystem boundaries:
 ``config/schema.py``'s ``ControlConfig`` fields (every ``control.*``
 read), ``utils/faults.py``'s ``KNOWN_SITES`` (every fault-injection
-site literal), and ``obs/costs.py``'s ``scf_stage_costs`` keys plus
+site literal), ``obs/costs.py``'s ``scf_stage_costs`` keys plus
 ``UNCOSTED_SPANS`` (every ``scf.*``/``md.*``/``serve.*``/``campaign.*``
-span name).
+span name), ``obs/events.py``'s ``KNOWN_EVENT_KINDS`` (every
+``emit(kind, ...)`` literal), and ``obs/metrics.py``'s
+``KNOWN_METRIC_NAMES`` (every ``REGISTRY.counter/gauge/histogram``
+name literal in production code — tests register throwaway names on
+private registries and are exempt).
 Each registry is parsed *by AST* from the live source — never imported
 — so the lint works in any environment and the registries cannot drift
 from what the rule checks.
@@ -35,6 +39,8 @@ class RegistryConfig:
     control_keys: frozenset | None = None
     fault_sites: frozenset | None = None
     span_keys: frozenset | None = None
+    event_kinds: frozenset | None = None
+    metric_names: frozenset | None = None
 
 
 def _module_tree(project: ProjectIndex, suffix: str,
@@ -104,11 +110,18 @@ def load_registry(project: ProjectIndex) -> RegistryConfig:
     faults = _module_tree(project, "utils.faults",
                           "sirius_tpu/utils/faults.py")
     costs = _module_tree(project, "obs.costs", "sirius_tpu/obs/costs.py")
+    events = _module_tree(project, "obs.events", "sirius_tpu/obs/events.py")
+    metrics = _module_tree(project, "obs.metrics",
+                           "sirius_tpu/obs/metrics.py")
     return RegistryConfig(
         control_keys=_control_keys(schema) if schema else None,
         fault_sites=(_tuple_of_strings(faults, "KNOWN_SITES")
                      if faults else None),
         span_keys=_span_keys(costs) if costs else None,
+        event_kinds=(_tuple_of_strings(events, "KNOWN_EVENT_KINDS")
+                     if events else None),
+        metric_names=(_tuple_of_strings(metrics, "KNOWN_METRIC_NAMES")
+                      if metrics else None),
     )
 
 
@@ -239,4 +252,95 @@ class UncostedSpan:
                     f"and no UNCOSTED_SPANS exemption in obs/costs.py")
 
 
-RULES = (UnknownControlKey, UnknownFaultSite, UncostedSpan)
+def _literal_strings(node: ast.AST) -> list[str]:
+    """String literal(s) an argument expression evaluates to: plain
+    constants plus both arms of a conditional expression
+    (``emit("drain" if mode == "drain" else "abort", ...)``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _literal_strings(node.body) + _literal_strings(node.orelse)
+    return []
+
+
+class UnknownEventKind:
+    """An ``obs.events.emit(kind, ...)`` literal not registered in
+    ``obs/events.KNOWN_EVENT_KINDS`` — the event would be written but
+    no consumer (trace exporter, replayer, dashboards) knows the kind
+    exists, so it silently vanishes from every downstream view."""
+
+    name = "unknown-event-kind"
+    wants_registry = True
+    _BASES = {"events", "obs", "obs_events", "_events"}
+
+    def run(self, project: ProjectIndex, registry=None):
+        reg = registry or load_registry(project)
+        kinds = reg.event_kinds
+        if kinds is None:
+            return
+        for fctx in project.files:
+            if (fctx.relpath.startswith("tests/")
+                    or fctx.relpath.endswith("obs/events.py")):
+                continue
+            for node in ast.walk(fctx.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                if isinstance(node.func, ast.Name):
+                    if node.func.id != "emit":
+                        continue
+                elif isinstance(node.func, ast.Attribute):
+                    if node.func.attr != "emit":
+                        continue
+                    base = dotted_name(node.func.value)
+                    if not base or base.split(".")[-1] not in self._BASES:
+                        continue
+                else:
+                    continue
+                for kind in _literal_strings(node.args[0]):
+                    if kind in kinds:
+                        continue
+                    yield project.finding(
+                        self.name, fctx, node,
+                        f"event kind \"{kind}\" is not in "
+                        f"obs/events.KNOWN_EVENT_KINDS")
+
+
+class UnknownMetricName:
+    """A ``REGISTRY.counter/gauge/histogram(name, ...)`` literal not
+    registered in ``obs/metrics.KNOWN_METRIC_NAMES`` — the series would
+    be exported under a name no dashboard query or CI smoke assertion
+    knows about. Private per-test registries (any base other than the
+    module-level ``REGISTRY``) are exempt."""
+
+    name = "unknown-metric-name"
+    wants_registry = True
+    _KINDS = {"counter", "gauge", "histogram"}
+
+    def run(self, project: ProjectIndex, registry=None):
+        reg = registry or load_registry(project)
+        names = reg.metric_names
+        if names is None:
+            return
+        for fctx in project.files:
+            if fctx.relpath.startswith("tests/"):
+                continue
+            for node in ast.walk(fctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._KINDS
+                        and node.args):
+                    continue
+                base = dotted_name(node.func.value)
+                if not base or base.split(".")[-1] != "REGISTRY":
+                    continue
+                for mname in _literal_strings(node.args[0]):
+                    if mname in names:
+                        continue
+                    yield project.finding(
+                        self.name, fctx, node,
+                        f"metric \"{mname}\" is not in "
+                        f"obs/metrics.KNOWN_METRIC_NAMES")
+
+
+RULES = (UnknownControlKey, UnknownFaultSite, UncostedSpan,
+         UnknownEventKind, UnknownMetricName)

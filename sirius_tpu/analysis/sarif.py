@@ -1,0 +1,81 @@
+"""SARIF 2.1.0 output for sirius-lint.
+
+SARIF (Static Analysis Results Interchange Format) is what code-review
+UIs ingest to annotate diffs inline: one ``run`` with a ``tool.driver``
+rule catalog and one ``result`` per finding. We emit the minimal valid
+document — rule metadata from each rule class's docstring, physical
+locations with 1-based line/column, and the rename-stable fingerprint
+under ``partialFingerprints`` so viewers can track a finding across
+commits the same way LINT_BASELINE.json does.
+
+Only the stdlib is used; the document is plain dicts serialised by the
+caller (``sirius-lint --sarif PATH``).
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+FINGERPRINT_KEY = "siriusLint/v2"
+
+
+def _rule_descriptor(rule_cls) -> dict:
+    doc = " ".join((rule_cls.__doc__ or "").split())
+    short = doc.split(". ")[0].rstrip(".") if doc else rule_cls.name
+    return {
+        "id": rule_cls.name,
+        "shortDescription": {"text": short[:240] or rule_cls.name},
+        "fullDescription": {"text": doc or rule_cls.name},
+        "defaultConfiguration": {"level": "warning"},
+    }
+
+
+def _result(finding, baselined: bool) -> dict:
+    return {
+        "ruleId": finding.rule,
+        "level": "note" if baselined else "warning",
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(finding.line, 1),
+                           "startColumn": max(finding.col + 1, 1)},
+            },
+        }],
+        "partialFingerprints": {FINGERPRINT_KEY: finding.fingerprint},
+        # SARIF baselineState is exactly our baseline semantics:
+        # "unchanged" findings are accepted debt, "new" ones fail CI
+        "baselineState": "unchanged" if baselined else "new",
+    }
+
+
+def to_sarif(findings, rules, new=None, root: str = ".") -> dict:
+    """Build the SARIF document. ``findings`` is the full list,
+    ``new`` the subset that is new vs the baseline (``None`` means no
+    baseline: everything is new)."""
+    new_keys = None
+    if new is not None:
+        new_keys = {(f.rule, f.path, f.line, f.col, f.message)
+                    for f in new}
+    results = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        baselined = new_keys is not None and key not in new_keys
+        results.append(_result(f, baselined))
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "sirius-lint",
+                "informationUri":
+                    "https://example.invalid/sirius_tpu/analysis",
+                "rules": [_rule_descriptor(r) for r in rules],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": f"file://{root}/"}},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }

@@ -1,0 +1,284 @@
+"""Transfer-budget rules: device→host crossings vs a declared manifest.
+
+The fused SCF loop's contract — *exactly one* ``[NUM_SCALARS]`` scalar
+readback per iteration, everything else stays on device — is what the
+runtime ``jax.transfer_guard`` test enforces dynamically. These rules
+prove the same contract statically, attributable to source lines, from
+the dataflow model in dataflow.py: a checked-in manifest
+(``TRANSFER_BUDGET.json`` at the repo root) declares *regions* of named
+functions and the number of crossings each may contain.
+
+Manifest schema::
+
+    {"version": 1, "regions": [
+       {"path": "sirius_tpu/dft/scf.py", "function": "run_scf",
+        "kind": "with:scf::fused_step", "budget": 0,
+        "allowed": ["faults.corrupt"],   # exempt, but must still occur
+        "note": "why this budget is what it is"},
+       ...]}
+
+Region kinds: ``with:NAME`` (every ``with profile("NAME")``-style block
+whose context call takes the string literal NAME), ``if:COND`` /
+``loop-if:COND`` (every ``if`` statement outside / inside a loop whose
+test matches COND), ``loops`` (every ``for``/``while`` body in the
+function), ``body`` (the whole function). A bare-identifier COND
+matches any test *mentioning* that name; a COND with non-identifier
+characters (``loop-if:fused is not None``) must equal the unparsed
+test exactly — use the exact form when several guards mention the same
+name. If-regions cover only the guarded body: the ``else`` branch is
+the *opposite* path (usually the unconstrained host fallback) and is
+never charged to the guard's budget.
+A crossing is attributed to the *innermost* declared region containing
+its line; crossings outside every declared region are unconstrained
+(host-path code is free to read back). ``allowed`` substrings exempt
+matching crossings from the count — but each pattern must still match
+at least one crossing, so the manifest cannot rot silently.
+
+Rules: ``transfer-budget`` (a region exceeds its budget — one finding
+per excess crossing), ``transfer-stale-region`` (a manifest entry that
+matches no function/AST region), ``transfer-stale-allowance`` (an
+``allowed`` pattern that exempts nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+
+from sirius_tpu.analysis.core import ProjectIndex
+from sirius_tpu.analysis.dataflow import DEV, DeviceModel
+
+MANIFEST_NAME = "TRANSFER_BUDGET.json"
+
+
+def load_manifest(project: ProjectIndex) -> dict | None:
+    path = os.path.join(project.root, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+@dataclasses.dataclass
+class _BodySpan:
+    """A line-range region (an ``if`` body without its ``else``);
+    duck-types the ``lineno``/``end_lineno`` the attributor needs."""
+
+    lineno: int
+    end_lineno: int
+    col_offset: int = 0
+
+
+def _match_regions(fn_node: ast.AST, kind: str) -> list[ast.AST]:
+    if kind == "body":
+        return [fn_node]
+    if kind == "loops":
+        return [n for n in ast.walk(fn_node)
+                if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+    if kind.startswith("with:"):
+        name = kind[5:]
+        out = []
+        for n in ast.walk(fn_node):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            for item in n.items:
+                ce = item.context_expr
+                if (isinstance(ce, ast.Call) and ce.args
+                        and isinstance(ce.args[0], ast.Constant)
+                        and ce.args[0].value == name):
+                    out.append(n)
+                    break
+        return out
+    if kind.startswith("if:") or kind.startswith("loop-if:"):
+        in_loop = kind.startswith("loop-if:")
+        cond = kind.split(":", 1)[1]
+        exact = not cond.isidentifier()
+        loops = [(n.lineno, n.end_lineno) for n in ast.walk(fn_node)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        out = []
+        for n in ast.walk(fn_node):
+            if not isinstance(n, ast.If):
+                continue
+            if exact:
+                if ast.unparse(n.test) != cond:
+                    continue
+            elif not any(isinstance(x, ast.Name) and x.id == cond
+                         for x in ast.walk(n.test)):
+                continue
+            inside = any(lo < n.lineno <= hi for lo, hi in loops)
+            if inside == in_loop:
+                # only the guarded body: the else branch is the opposite
+                # path and must not be charged to this guard's budget
+                out.append(_BodySpan(n.body[0].lineno,
+                                     n.body[-1].end_lineno))
+        return out
+    return []
+
+
+def _span(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 1),
+            getattr(node, "end_lineno", getattr(node, "lineno", 1)))
+
+
+def analyze(project: ProjectIndex, manifest: dict | None = None) -> list:
+    """Evaluate every manifest region; returns (cached) region records:
+    ``{entry, fi, nodes, counted, allowed_hits, stale_allowed, stale}``
+    where ``counted`` is the list of budget-relevant crossings."""
+    cached = getattr(project, "_transfer_budget_report", None)
+    if cached is not None and manifest is None:
+        return cached
+    manifest = manifest if manifest is not None else load_manifest(project)
+    report: list[dict] = []
+    if not manifest:
+        project._transfer_budget_report = report
+        return report
+    model = DeviceModel.of(project)
+    for entry in manifest.get("regions", []):
+        mi = project.by_relpath.get(entry.get("path", ""))
+        fi = mi.functions.get(entry.get("function", "")) if mi else None
+        rec = {"entry": entry, "fi": fi, "nodes": [], "counted": [],
+               "allowed_hits": {p: 0 for p in entry.get("allowed", [])},
+               "stale": False}
+        report.append(rec)
+        if fi is None:
+            rec["stale"] = True
+            continue
+        rec["nodes"] = _match_regions(fi.node, entry.get("kind", "body"))
+        if not rec["nodes"]:
+            rec["stale"] = True
+
+    # innermost-region attribution across all entries of one function
+    by_fn: dict[tuple, list[dict]] = {}
+    for rec in report:
+        if rec["fi"] is not None and rec["nodes"]:
+            by_fn.setdefault(rec["fi"].key, []).append(rec)
+    for key, recs in by_fn.items():
+        fi = recs[0]["fi"]
+        fctx = fi.module.fctx
+        for crossing in model.crossings(fi):
+            if DEV not in crossing.origins:
+                # parameter-origin crossings are summary inputs: they
+                # only become transfers at call sites that pass device
+                # values, where they surface as "call" crossings
+                continue
+            line = getattr(crossing.node, "lineno", 0)
+            best = None  # (span size, rec)
+            for rec in recs:
+                for node in rec["nodes"]:
+                    lo, hi = _span(node)
+                    if lo <= line <= hi and (
+                            best is None or hi - lo < best[0]):
+                        best = (hi - lo, rec)
+            if best is None:
+                continue
+            rec = best[1]
+            text = fctx.line_text(line)
+            allowed = None
+            for pat in rec["allowed_hits"]:
+                if pat in text or pat in crossing.detail:
+                    allowed = pat
+                    break
+            if allowed is not None:
+                rec["allowed_hits"][allowed] += 1
+            else:
+                rec["counted"].append(crossing)
+    project._transfer_budget_report = report
+    return report
+
+
+def budget_report(project: ProjectIndex,
+                  manifest: dict | None = None) -> list[dict]:
+    """JSON-ready view of :func:`analyze` (tests pin this shape)."""
+    out = []
+    for rec in analyze(project, manifest):
+        e = rec["entry"]
+        out.append({
+            "path": e.get("path"), "function": e.get("function"),
+            "kind": e.get("kind"), "budget": e.get("budget", 0),
+            "stale": rec["stale"],
+            "count": len(rec["counted"]),
+            "crossings": [
+                {"line": getattr(c.node, "lineno", 0), "kind": c.kind,
+                 "detail": c.detail} for c in rec["counted"]],
+            "allowed_hits": dict(rec["allowed_hits"]),
+        })
+    return out
+
+
+class TransferBudget:
+    """A declared region contains more device→host crossings than its
+    budget — the fused-SCF one-readback-per-iteration contract (or a
+    zero-transfer hot region) is broken at the flagged line."""
+
+    name = "transfer-budget"
+
+    def run(self, project: ProjectIndex):
+        for rec in analyze(project):
+            if rec["stale"]:
+                continue
+            entry, fi = rec["entry"], rec["fi"]
+            budget = int(entry.get("budget", 0))
+            counted = sorted(
+                rec["counted"],
+                key=lambda c: getattr(c.node, "lineno", 0))
+            for c in counted[budget:]:
+                yield project.finding(
+                    self.name, fi, c.node,
+                    f"device->host crossing ({c.detail}) exceeds the "
+                    f"budget of {budget} for region "
+                    f"`{entry.get('kind')}` of `{fi.qualname}` "
+                    f"(TRANSFER_BUDGET.json)")
+
+
+class TransferStaleRegion:
+    """A manifest entry naming a function or region that no longer
+    exists — the budget it declares protects nothing."""
+
+    name = "transfer-stale-region"
+
+    def run(self, project: ProjectIndex):
+        for rec in analyze(project):
+            if not rec["stale"]:
+                continue
+            entry = rec["entry"]
+            mi = project.by_relpath.get(entry.get("path", ""))
+            fctx = mi.fctx if mi else (
+                project.files[0] if project.files else None)
+            if fctx is None:
+                continue
+            node = rec["fi"].node if rec["fi"] is not None else None
+            yield project.finding(
+                self.name, fctx, node,
+                f"TRANSFER_BUDGET.json region `{entry.get('kind')}` of "
+                f"`{entry.get('path')}::{entry.get('function')}` matches "
+                f"nothing in the tree; update or drop the entry")
+
+
+class TransferStaleAllowance:
+    """An ``allowed`` pattern that exempted no crossing — either the
+    sanctioned readback was removed (tighten the budget) or the pattern
+    is a typo silently allowing nothing."""
+
+    name = "transfer-stale-allowance"
+
+    def run(self, project: ProjectIndex):
+        for rec in analyze(project):
+            if rec["stale"] or rec["fi"] is None:
+                continue
+            entry, fi = rec["entry"], rec["fi"]
+            for pat, hits in sorted(rec["allowed_hits"].items()):
+                if hits == 0:
+                    yield project.finding(
+                        self.name, fi, rec["nodes"][0],
+                        f"allowed pattern \"{pat}\" in region "
+                        f"`{entry.get('kind')}` of `{fi.qualname}` "
+                        f"matches no crossing; drop it from "
+                        f"TRANSFER_BUDGET.json")
+
+
+RULES = (TransferBudget, TransferStaleRegion, TransferStaleAllowance)

@@ -61,6 +61,40 @@ _lock = threading.Lock()
 _fh = None
 _path: str | None = None
 
+# The authoritative kind registry: every ``emit(kind, ...)`` literal in
+# the tree must name one of these (enforced by sirius-lint's
+# unknown-event-kind rule, which parses this tuple by AST), so consumers
+# — the trace exporter, the replayer, dashboards — can rely on the set
+# being closed. Keep the docstring above in sync when adding one.
+KNOWN_EVENT_KINDS = (
+    "abort",
+    "autosave",
+    "backoff",
+    "campaign_done",
+    "campaign_handoff",
+    "campaign_node_done",
+    "campaign_resume",
+    "campaign_submit",
+    "checkpoint",
+    "deadline_feasibility",
+    "drain",
+    "job_transition",
+    "journal_replay",
+    "journal_replay_job",
+    "md_step",
+    "numerics_probe",
+    "quarantine",
+    "recovery",
+    "run_manifest",
+    "scf_done",
+    "scf_forecast",
+    "scf_iteration",
+    "span",
+    "trace_capture",
+    "watchdog_fire",
+    "worker_restart",
+)
+
 
 def configure(path: str) -> str:
     """Open (append) the JSONL sink at ``path``. Returns the path.

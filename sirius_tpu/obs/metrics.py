@@ -348,6 +348,64 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry()
 
+# The authoritative metric-name registry: every
+# ``REGISTRY.counter/gauge/histogram("name", ...)`` literal in
+# production code must name one of these (enforced by sirius-lint's
+# unknown-metric-name rule, which parses this tuple by AST) so dashboard
+# queries and the CI /metrics smoke can rely on the namespace being
+# closed. Tests register throwaway names on private registries and are
+# exempt.
+KNOWN_METRIC_NAMES = (
+    # counters
+    "campaign_node_scf_iterations_total",
+    "campaign_nodes_total",
+    "jax_backend_compiles_total",
+    "md_steps_total",
+    "scf_aborts_total",
+    "scf_autosaves_total",
+    "scf_iterations_total",
+    "scf_recoveries_total",
+    "scf_runs_total",
+    "serve_cache_exec_total",
+    "serve_cache_jobs_total",
+    "serve_job_failures_total",
+    "serve_job_retries_total",
+    "serve_job_transitions_total",
+    "serve_journal_records_total",
+    "serve_journal_replays_total",
+    "serve_quarantines_total",
+    "serve_queue_rejected_total",
+    "serve_watchdog_fires_total",
+    "serve_worker_restarts_total",
+    # gauges
+    "jax_device_memory_bytes",
+    "md_conserved_drift_ha",
+    "md_extrapolation_rel_error",
+    "numerics_probe_energy_impact_ha",
+    "numerics_probe_rel_err",
+    "scf_density_rms",
+    "scf_forecast_iterations",
+    "scf_forecast_warning",
+    "scf_numerics_ledger",
+    "scf_total_energy_ha",
+    "serve_queue_depth",
+    "serve_queue_depth_high_water",
+    # histograms
+    "campaign_wall_seconds",
+    "jax_backend_compile_seconds",
+    "jax_lowering_seconds",
+    "jax_trace_seconds",
+    "md_scf_iterations_per_step",
+    "md_step_seconds",
+    "perf_span_seconds",
+    "scf_iteration_seconds",
+    "serve_backoff_seconds",
+    "serve_job_latency_seconds",
+    "serve_job_run_seconds",
+    "serve_job_state_seconds",
+    "span_seconds",
+)
+
 
 # ---------------------------------------------------------------------------
 # jax.monitoring backend listeners (generalized from serve/cache.py)

@@ -1,17 +1,33 @@
-"""sirius-lint (ISSUE 9): JAX rules on jit-reachable code, serve lock-order
+"""sirius-lint: JAX rules on jit-reachable code, serve lock-order
 analysis, registry-consistency checks, suppression comments, the findings
 baseline, and the live-tree gate (repo must lint clean modulo the checked-in
-LINT_BASELINE.json, with zero lock cycles in serve/)."""
+LINT_BASELINE.json, with zero lock cycles in serve/).
+
+The v2 families (interprocedural jit-dataflow): recompile hazards
+(compilerules), transfer budgets against TRANSFER_BUDGET.json
+(transferrules — including the live proof of the fused SCF
+one-readback-per-iteration contract), sharding consistency and the
+per-driver inventory (shardrules), event/metric registry cross-checks,
+rename-stable fingerprints, the stale-suppression audit, SARIF output,
+and the <60 s lint-runtime budget."""
 
 import json
 import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
-from sirius_tpu.analysis import jaxrules, lockrules, registryrules
+from sirius_tpu.analysis import (
+    compilerules,
+    jaxrules,
+    lockrules,
+    registryrules,
+    shardrules,
+    transferrules,
+)
 from sirius_tpu.analysis.core import (
     DEFAULT_SCAN,
     LintEngine,
@@ -21,6 +37,7 @@ from sirius_tpu.analysis.core import (
     write_baseline,
 )
 from sirius_tpu.analysis.registryrules import RegistryConfig
+from sirius_tpu.analysis.sarif import to_sarif
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -521,9 +538,17 @@ def test_cli_exit_codes(tmp_path):
 
 
 @pytest.fixture(scope="module")
-def live_run():
+def live_engine():
+    t0 = time.perf_counter()
     eng = LintEngine(REPO, paths=collect_files(REPO, DEFAULT_SCAN))
-    return eng.run()
+    eng.findings = eng.run()
+    eng.wall_seconds = time.perf_counter() - t0
+    return eng
+
+
+@pytest.fixture(scope="module")
+def live_run(live_engine):
+    return live_engine.findings
 
 
 def test_live_tree_clean_modulo_baseline(live_run):
@@ -550,3 +575,438 @@ def test_live_tree_has_no_lock_cycles(live_run):
 def test_live_tree_fault_sites_consistent(live_run):
     """KNOWN_SITES covers every site the tree arms/checks."""
     assert [f for f in live_run if f.rule == "unknown-fault-site"] == []
+
+
+# ----------------------------------------------- recompile-hazard rules
+
+
+def test_recompile_jit_in_loop(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": JIT_HEADER + """
+    def hot(xs):
+        for x in xs:
+            f = jax.jit(lambda v: v * 2)  # rebuilt every iteration
+            f(x)
+
+    def cached(cache, sig, fn, xs):
+        for x in xs:
+            g = cache.get(sig, lambda: jax.jit(fn))  # miss-only builder
+            g(x)
+    """}, rules=[compilerules.RecompileJitInLoop])
+    assert names(found) == ["recompile-jit-in-loop"]
+    assert "hot" in found[0].message
+
+
+def test_recompile_unstable_static(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": JIT_HEADER + """
+    def drive(xs):
+        step = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+        for i, x in enumerate(xs):
+            step(x, i)   # loop index at a static position
+            step(x, 16)  # literal: compiles once, fine
+    """}, rules=[compilerules.RecompileUnstableStatic])
+    assert names(found) == ["recompile-unstable-static"]
+    assert "loop variable `i`" in found[0].message
+
+
+def test_cache_key_trace_constant(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/pipe.py": JIT_HEADER + """
+    class Pipeline:
+        def __init__(self, cache, nb, dtype):
+            self.nb = nb
+            self.dtype = dtype
+            self.scale = 2.0
+            self.run = cache.get(self._trace_signature(),
+                                 lambda: jax.jit(self._impl))
+
+        def _trace_signature(self):
+            return ("pipeline", self.nb, self.dtype)
+
+        def _impl(self, x):
+            return x.astype(self.dtype) * self.nb * self.scale
+    """}, rules=[compilerules.CacheKeyTraceConstant])
+    assert names(found) == ["cache-key-trace-constant"]
+    assert "self.scale" in found[0].message
+    assert "_trace_signature" in found[0].message
+
+
+def test_cache_key_trace_constant_complete_signature_ok(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/pipe.py": JIT_HEADER + """
+    class Pipeline:
+        def __init__(self, cache, nb):
+            self.nb = nb
+            self.run = cache.get(self._trace_signature(),
+                                 lambda: jax.jit(self._impl))
+
+        def _trace_signature(self):
+            return ("pipeline", self.nb)
+
+        def _impl(self, x):
+            return x * self.nb
+    """}, rules=[compilerules.CacheKeyTraceConstant])
+    assert found == []
+
+
+# ------------------------------------------------- transfer-budget rules
+
+
+def test_transfer_budget_exceeded(tmp_path):
+    manifest = json.dumps({"version": 1, "regions": [
+        {"path": "sirius_tpu/mod.py", "function": "drive",
+         "kind": "loops", "budget": 1}]})
+    _, found = lint(tmp_path, {
+        "TRANSFER_BUDGET.json": manifest,
+        "sirius_tpu/mod.py": JIT_HEADER + """
+    def drive(xs):
+        tot = 0.0
+        for x in xs:
+            y = jnp.dot(x, x)
+            a = np.asarray(y)   # readback 1: within budget
+            tot += float(y)     # readback 2: over budget
+        return a, tot
+    """}, rules=[transferrules.TransferBudget])
+    assert names(found) == ["transfer-budget"]
+    assert "budget of 1" in found[0].message
+    assert "float()" in found[0].message
+
+
+def test_transfer_budget_allowed_and_stale(tmp_path):
+    manifest = json.dumps({"version": 1, "regions": [
+        {"path": "sirius_tpu/mod.py", "function": "drive",
+         "kind": "loops", "budget": 0,
+         "allowed": ["np.asarray", "never-matches"]},
+        {"path": "sirius_tpu/mod.py", "function": "gone",
+         "kind": "body", "budget": 0}]})
+    _, found = lint(tmp_path, {
+        "TRANSFER_BUDGET.json": manifest,
+        "sirius_tpu/mod.py": JIT_HEADER + """
+    def drive(xs):
+        for x in xs:
+            y = jnp.dot(x, x)
+            a = np.asarray(y)  # exempted by the allowed pattern
+        return a
+    """})
+    assert names(found) == ["transfer-stale-allowance",
+                            "transfer-stale-region"]
+    msgs = " | ".join(f.message for f in found)
+    assert "never-matches" in msgs and "gone" in msgs
+
+
+def test_transfer_if_region_excludes_else_branch(tmp_path):
+    manifest = json.dumps({"version": 1, "regions": [
+        {"path": "sirius_tpu/mod.py", "function": "drive",
+         "kind": "loop-if:fast", "budget": 0}]})
+    _, found = lint(tmp_path, {
+        "TRANSFER_BUDGET.json": manifest,
+        "sirius_tpu/mod.py": JIT_HEADER + """
+    def drive(xs, fast):
+        for x in xs:
+            y = jnp.dot(x, x)
+            if fast:
+                z = y + 1
+            else:
+                z = np.asarray(y)  # host fallback: not the guard's debt
+        return z
+    """}, rules=[transferrules.TransferBudget])
+    assert found == []
+
+
+def test_transfer_param_crossing_interprocedural(tmp_path):
+    """A helper that moves its parameter to host taints its call sites:
+    the crossing lands at the caller's line, where the device value is."""
+    manifest = json.dumps({"version": 1, "regions": [
+        {"path": "sirius_tpu/mod.py", "function": "drive",
+         "kind": "loops", "budget": 0}]})
+    _, found = lint(tmp_path, {
+        "TRANSFER_BUDGET.json": manifest,
+        "sirius_tpu/mod.py": JIT_HEADER + """
+    def to_host(v):
+        return np.asarray(v)
+
+    def drive(xs):
+        for x in xs:
+            y = jnp.dot(x, x)
+            h = to_host(y)  # the transfer happens here, one hop down
+        return h
+    """}, rules=[transferrules.TransferBudget])
+    assert names(found) == ["transfer-budget"]
+    assert "to_host" in found[0].message
+
+
+def test_live_fused_one_readback_contract(live_engine):
+    """The static proof of the fused-SCF transfer contract: exactly one
+    scalar readback per fused iteration, an allowed supervised snapshot,
+    a transfer-free profile span, and a sync-free jitted step."""
+    rows = transferrules.budget_report(live_engine.project)
+    assert rows, "TRANSFER_BUDGET.json missing or empty"
+    for r in rows:
+        assert not r["stale"], f"stale manifest region: {r}"
+        assert r["count"] <= r["budget"], f"budget exceeded: {r}"
+    fused_iter = next(r for r in rows
+                      if r["kind"] == "loop-if:fused is not None")
+    assert fused_iter["count"] == 1
+    assert fused_iter["crossings"][0]["kind"] == "asarray"
+    assert fused_iter["allowed_hits"] == {"fused.fetch_state": 1}
+    span = next(r for r in rows if r["kind"] == "with:scf::fused_step")
+    assert span["count"] == 0 and span["budget"] == 0
+    step = next(r for r in rows if r["function"] == "FusedScf.step")
+    assert step["count"] == 0 and step["budget"] == 0
+
+
+# ------------------------------------------- sharding-consistency rules
+
+SHARD_HEADER = """\
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+"""
+
+
+def test_shard_unknown_axis(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": SHARD_HEADER + """
+    def make(devs):
+        return Mesh(np.array(devs), ("k", "b"))
+
+    def good():
+        return P("k", None)
+
+    def bad():
+        return P("q")  # no mesh anywhere declares "q"
+    """}, rules=[shardrules.ShardUnknownAxis])
+    assert names(found) == ["shard-unknown-axis"]
+    assert '"q"' in found[0].message
+
+
+def test_shard_ctor_alias_resolution(tmp_path):
+    """`Mesh as _Mesh` / `PartitionSpec as _P` resolve through the
+    import map — the scf.py FFT-mesh idiom must not false-positive."""
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": """
+    import numpy as np
+    from jax.sharding import Mesh as _Mesh, PartitionSpec as _P
+
+    def make(devs):
+        return _Mesh(np.array(devs), ("g",))
+
+    def spec():
+        return _P("g")
+    """}, rules=[shardrules.ShardUnknownAxis])
+    assert found == []
+
+
+def test_shard_axis_mismatch(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": SHARD_HEADER + """
+    def put(devs):
+        gmesh = Mesh(np.array(devs), ("g",))
+        kmesh = Mesh(np.array(devs), ("k",))
+        ok = NamedSharding(gmesh, P("g"))
+        bad = NamedSharding(gmesh, P("k"))  # "k" exists, not on gmesh
+        return ok, bad, kmesh
+    """}, rules=[shardrules.ShardAxisMismatch])
+    assert names(found) == ["shard-axis-mismatch"]
+    assert '"k"' in found[0].message
+
+
+def test_shard_constraint_in_loop(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": JIT_HEADER + """
+    from jax.lax import with_sharding_constraint
+
+    @jax.jit
+    def hot(xs, spec):
+        out = xs
+        for _ in range(3):
+            out = with_sharding_constraint(out, spec)
+        return out
+
+    def host(xs, spec):
+        for _ in range(3):
+            xs = with_sharding_constraint(xs, spec)  # not jit-reachable
+        return xs
+    """}, rules=[shardrules.ShardConstraintInLoop])
+    assert names(found) == ["shard-constraint-in-loop"]
+    assert "hot" in found[0].message
+
+
+def test_live_sharding_inventory_schema(live_engine):
+    """Schema-pinning for `sirius-lint --report sharding`: the five
+    driver rows, the row shape, and the load-bearing live facts."""
+    inv = shardrules.sharding_inventory(live_engine.project)
+    assert inv["version"] == 1
+    assert inv["declared_axes"] == ["b", "g", "k"]
+    assert sorted(inv["drivers"]) == [
+        "campaigns", "md", "relax", "scf", "serve"]
+    row = inv["drivers"]["scf"]
+    assert sorted(row) == [
+        "axes_used", "collectives", "donate_argnums", "indexed",
+        "jit_sites", "meshes", "named_shardings", "partition_specs",
+        "path", "sharding_constraints"]
+    assert row["indexed"], "scf driver must be indexed"
+    assert any(m["axes"] == ["g"] for m in row["meshes"]), (
+        "scf's distributed-FFT mesh (axis g) missing from the inventory")
+    # the delegation diff signal: serve/md/relax construct no meshes of
+    # their own — all sharding flows through scf/parallel helpers
+    for name in ("serve", "md", "relax"):
+        assert inv["drivers"][name]["meshes"] == [], name
+    assert any(inv["parallel"].values()), "parallel/ rows missing"
+
+
+# ------------------------------------- event/metric registry cross-check
+
+REGISTRY_V2 = RegistryConfig(
+    event_kinds=frozenset({"scf_iteration"}),
+    metric_names=frozenset({"scf_iterations_total"}),
+)
+
+
+def test_unknown_event_kind(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": """
+    from sirius_tpu.obs import events
+
+    def f(mode):
+        events.emit("scf_iteration", it=1)
+        events.emit("scf_iterration", it=2)  # typo
+        events.emit("drain" if mode else "scf_iteration")  # one bad arm
+    """}, rules=[registryrules.UnknownEventKind], registry=REGISTRY_V2)
+    assert names(found) == ["unknown-event-kind"] * 2
+    msgs = " | ".join(f.message for f in found)
+    assert "scf_iterration" in msgs and "drain" in msgs
+
+
+def test_unknown_metric_name(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": """
+    from sirius_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+    def f():
+        REGISTRY.counter("scf_iterations_total").inc()
+        REGISTRY.counter("scf_itertions_total").inc()  # typo
+        private = MetricsRegistry()
+        private.counter("throwaway_total").inc()  # private registry: exempt
+    """}, rules=[registryrules.UnknownMetricName], registry=REGISTRY_V2)
+    assert names(found) == ["unknown-metric-name"]
+    assert "scf_itertions_total" in found[0].message
+
+
+def test_live_tree_event_and_metric_registries(live_run):
+    """KNOWN_EVENT_KINDS / KNOWN_METRIC_NAMES cover the live tree."""
+    assert [f for f in live_run
+            if f.rule in ("unknown-event-kind",
+                          "unknown-metric-name")] == []
+
+
+# --------------------------------------- fingerprints, suppressions, SARIF
+
+
+def test_fingerprint_rename_stable(tmp_path):
+    """Fingerprints key on (rule, normalized text, enclosing qualname):
+    moving the file and shifting its lines must not churn the baseline,
+    but a different enclosing function is a different finding."""
+    body = """
+    @jax.jit
+    def f(x):
+        return np.sum(x)
+    """
+    a, b, c = tmp_path / "a", tmp_path / "b", tmp_path / "c"
+    _, fa = lint(a, {"sirius_tpu/alpha.py": JIT_HEADER + body},
+                 rules=[jaxrules.JitNumpyCall])
+    _, fb = lint(b, {"sirius_tpu/renamed/beta.py":
+                     JIT_HEADER + "\n\n\n" + body},
+                 rules=[jaxrules.JitNumpyCall])
+    assert fa[0].fingerprint == fb[0].fingerprint
+    assert fa[0].line != fb[0].line  # the shift the fingerprint ignores
+    _, fc = lint(c, {"sirius_tpu/alpha.py": JIT_HEADER + """
+    @jax.jit
+    def g(x):
+        return np.sum(x)
+    """}, rules=[jaxrules.JitNumpyCall])
+    assert fc[0].fingerprint != fa[0].fingerprint
+
+
+def test_stale_suppression_audit(tmp_path):
+    eng, found = lint(tmp_path, {"sirius_tpu/mod.py": JIT_HEADER + """
+    @jax.jit
+    def f(x):
+        return np.sum(x)  # sirius-lint: disable=jit-numpy-call
+
+    def g(x):
+        return x  # sirius-lint: disable=jit-numpy-call
+
+    def h(x):
+        return x  # sirius-lint: disable=no-such-rule
+    """}, rules=[jaxrules.JitNumpyCall])
+    assert found == []  # the one real violation is suppressed
+    stale = eng.stale_suppressions()
+    assert [(s["rule"], s["reason"]) for s in stale] == [
+        ("jit-numpy-call", "never fired"),
+        ("no-such-rule", "unknown rule")]
+
+
+def test_sarif_output(tmp_path):
+    _, found = lint(tmp_path, {"sirius_tpu/mod.py": JIT_HEADER + """
+    @jax.jit
+    def f(x):
+        return np.sum(x)
+    """}, rules=[jaxrules.JitNumpyCall])
+    doc = to_sarif(found, [jaxrules.JitNumpyCall], new=[],
+                   root=str(tmp_path))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "jit-numpy-call"]
+    res = run["results"][0]
+    assert res["ruleId"] == "jit-numpy-call"
+    assert res["baselineState"] == "unchanged"  # new=[]: all baselined
+    assert res["partialFingerprints"]["siriusLint/v2"] == (
+        found[0].fingerprint)
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == found[0].line
+    assert loc["artifactLocation"]["uri"] == "sirius_tpu/mod.py"
+
+
+def test_cli_sarif_suppressions_and_sharding(tmp_path, capsys):
+    # in-process cli.main() — subprocess spawns would re-pay the jax
+    # import for every flag combination
+    from sirius_tpu.analysis import cli as lint_cli
+
+    (tmp_path / "sirius_tpu").mkdir()
+    (tmp_path / "sirius_tpu" / "mod.py").write_text(textwrap.dedent(
+        JIT_HEADER + """
+    def f(x):
+        return x  # sirius-lint: disable=jit-numpy-call
+    """))
+
+    def cli(*argv):
+        rc = lint_cli.main(["--root", str(tmp_path), *argv])
+        out = capsys.readouterr()
+        return rc, out.out, out.err
+
+    # stale suppression: advisory by default, fatal under --strict;
+    # SARIF rides along in the same invocation
+    sarif_path = tmp_path / "out.sarif"
+    rc, out, err = cli("--check-suppressions", "--sarif", str(sarif_path))
+    assert rc == 0 and "stale suppression" in out
+    doc = json.load(open(sarif_path))
+    assert doc["version"] == "2.1.0" and doc["runs"][0]["results"] == []
+    rc, out, err = cli("--check-suppressions", "--strict")
+    assert rc == 1, out + err
+    # the audit needs the full catalog
+    rc, out, err = cli("--check-suppressions", "--rules", "jit-numpy-call")
+    assert rc == 2
+    # sharding inventory on stdout
+    rc, out, err = cli("--report", "sharding")
+    assert rc == 0, out + err
+    inv = json.loads(out)
+    assert inv["version"] == 1 and "drivers" in inv
+
+
+# ------------------------------------------------- self-scan and budget
+
+
+def test_default_scan_includes_tests(live_engine):
+    """Satellite: the lint indexes its own test tree, so cross-package
+    call resolution covers tests/ fixtures too."""
+    assert "tests" in DEFAULT_SCAN
+    assert any(f.relpath == "tests/test_lint.py"
+               for f in live_engine.project.files)
+
+
+def test_live_lint_runtime_budget(live_engine):
+    """The whole-tree lint (index + all six families) must stay under
+    the 60 s CI budget."""
+    assert live_engine.wall_seconds < 60.0
