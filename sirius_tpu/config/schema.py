@@ -144,6 +144,17 @@ class ControlConfig:
     # forecasted remaining iterations x the recent iteration time and
     # emits "deadline_feasibility" events when the verdict changes.
     deadline_ts: float = 0.0
+    # straggler watchdog (device-fault resilience, utils/devfail.py):
+    # when enabled, run_scf compares each iteration's wall time against
+    # the obs/costs.py analytic model and the run's own healthy-median
+    # baseline; straggler_iters consecutive iterations more than
+    # straggler_ratio slower preempt the run at a snapshot boundary
+    # (StragglerPreempt) so the serving layer can finish the job on a
+    # healthy slice. "auto" means OFF standalone and ON under serve
+    # (serve/scheduler.py resolves it to True at job admission).
+    straggler_detect: object = "auto"
+    straggler_ratio: float = 4.0
+    straggler_iters: int = 3
 
 
 @dataclasses.dataclass

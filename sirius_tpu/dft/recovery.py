@@ -30,6 +30,27 @@ ScfSupervisor turns those sites into a bounded retry loop:
 run_scf owns the actual state mutation (restoring x_mix, rebuilding the
 potential and the fused program); the supervisor owns detection, the
 snapshot payload, escalation bookkeeping, and the diagnostic dump.
+
+Device OOM rides a SEPARATE ladder (``OOM_LADDER``): an HBM
+RESOURCE_EXHAUSTED (classified by utils/devfail.py, injected by the
+``device.oom`` fault site) means the memory plan is wrong, not the
+physics — so instead of flushing mixer history the rungs shrink the
+memory footprint, each journaled/metered like a divergence rung and
+resumed from the last snapshot rather than restarting:
+
+  rung 0: shrink beta_chunk_budget_bytes (and halve beta_chunk_size) so
+          the chunked-projector path engages, or engages with smaller
+          chunks; repeatable while the chunks can still halve
+  rung 1: force the chunked beta path outright (when the deck is
+          eligible: single k, ns=1, no Hubbard/PAW/mGGA)
+  rung 2: disable device_scf — host fallback, smallest resident footprint
+  rung 3+ (or recovery budget exhausted, or no applicable rung): abort —
+          the serving layer then retries the job with the same rungs
+          pre-applied via devfail.apply_oom_hint
+
+Inapplicable rungs are skipped (a host-path run has no device_scf to
+disable; a multi-k deck cannot chunk): the ladder escalates to the first
+rung that actually changes the memory plan.
 """
 
 from __future__ import annotations
@@ -56,6 +77,15 @@ LADDER = (
     "abort",
 )
 
+# the device-OOM degradation ladder (sentinel "device_oom"): memory-plan
+# rungs, not numerics rungs — see the module docstring
+OOM_LADDER = (
+    "shrink_beta_budget",
+    "force_beta_chunked",
+    "disable_device_scf",
+    "abort",
+)
+
 
 class ScfAbortError(FloatingPointError):
     """SCF diverged beyond the recovery ladder. Subclasses
@@ -76,6 +106,10 @@ class RecoveryDirective:
     beta: float | None = None  # new mixer beta (None = keep)
     kind: str | None = None  # new mixer kind (None = keep)
     disable_device: bool = False
+    # OOM-ladder rungs (sentinel "device_oom"): shrink the chunked-beta
+    # engagement budget / halve the chunk size, or force the chunked path
+    shrink_beta_budget: bool = False
+    force_beta_chunked: bool = False
 
 
 class ScfSupervisor:
@@ -114,6 +148,7 @@ class ScfSupervisor:
         self.beta0 = float(mixer_beta)
         self.kind0 = str(mixer_kind)
         self.rung = 0
+        self.oom_rung = 0  # separate pointer into OOM_LADDER
         self.recoveries = 0
         self.history: list[dict] = []  # one entry per recovery event
         # rollback payload: dict set by run_scf via snapshot()
@@ -251,7 +286,14 @@ class ScfSupervisor:
                 state: dict | None = None) -> RecoveryDirective:
         """A sentinel fired at iteration `it`. Escalate one ladder rung and
         return the directive; raises ScfAbortError when the ladder (or the
-        recovery budget, or the absence of any snapshot) is exhausted."""
+        recovery budget, or the absence of any snapshot) is exhausted.
+
+        The "device_oom" sentinel routes to the OOM degradation ladder
+        (`state` must then carry the memory-plan flags — see
+        _recover_oom); every other sentinel takes the divergence ladder.
+        """
+        if sentinel == "device_oom":
+            return self._recover_oom(it, detail, state)
         if (not self.enabled or self._snap is None
                 or self.recoveries >= self.max_recoveries
                 or self.rung >= len(LADDER) - 1):
@@ -275,6 +317,68 @@ class ScfSupervisor:
             d.beta = 0.5 * self.beta0
             d.kind = "linear"
         if rung >= 2:
+            d.disable_device = True
+        self.reset_trend()
+        return d
+
+    def _recover_oom(self, it: int, detail: str,
+                     state: dict | None) -> RecoveryDirective:
+        """Device OOM at iteration `it`: escalate to the first OOM-ladder
+        rung that actually changes the memory plan, given the run's
+        current path flags in `state`:
+
+          beta_chunk_eligible  the chunked projector path can engage
+                               (single k, ns=1, no Hubbard/PAW/mGGA, not
+                               explicitly disabled)
+          beta_chunked         the chunked path is already active
+          beta_chunk_can_halve beta_chunk_size is still above the floor
+          device_scf           the fused device path is active
+
+        Rung 0 is repeatable while the chunks can still halve (a fully
+        host-side, already-chunked run has no rung 1/2 left to take)."""
+        st = state or {}
+        eligible = bool(st.get("beta_chunk_eligible"))
+        active = bool(st.get("beta_chunked"))
+        can_halve = bool(st.get("beta_chunk_can_halve", True))
+        device = bool(st.get("device_scf"))
+        can_shrink = (eligible and not active) or (active and can_halve)
+        choice = None
+        for r in range(self.oom_rung, len(OOM_LADDER) - 1):
+            a = OOM_LADDER[r]
+            if a == "shrink_beta_budget" and can_shrink:
+                choice = r
+                break
+            if a == "force_beta_chunked" and eligible and not active:
+                choice = r
+                break
+            if a == "disable_device_scf" and device:
+                choice = r
+                break
+        if choice is None and can_shrink:
+            choice = 0  # fully degraded path: keep halving the chunks
+        if (choice is None or not self.enabled or self._snap is None
+                or self.recoveries >= self.max_recoveries):
+            raise self._abort("device_oom", it, detail, state)
+        action = OOM_LADDER[choice]
+        self.oom_rung = max(self.oom_rung, choice + 1)
+        self.recoveries += 1
+        self.history.append({
+            "iteration": it,
+            "sentinel": "device_oom",
+            "detail": detail,
+            "ladder": "oom",
+            "rung": choice,
+            "action": action,
+            "rolled_back_to": self._snap["it"],
+        })
+        _RECOVERIES.inc(sentinel="device_oom", action=action)
+        obs_events.emit("recovery", **self.history[-1])
+        d = RecoveryDirective(rung=choice)
+        if action == "shrink_beta_budget":
+            d.shrink_beta_budget = True
+        elif action == "force_beta_chunked":
+            d.force_beta_chunked = True
+        elif action == "disable_device_scf":
             d.disable_device = True
         self.reset_trend()
         return d
@@ -309,6 +413,7 @@ class ScfSupervisor:
             "deck": self.deck_label,
             "recoveries": self.recoveries,
             "rung": self.rung,
+            "oom_rung": self.oom_rung,
             "ladder_history": list(self.history),
             "etot_tail": list(self._etot_tail),
             "rms_tail": list(self._rms_tail),

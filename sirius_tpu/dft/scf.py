@@ -44,6 +44,7 @@ from sirius_tpu.obs import tracing as obs_tracing
 from sirius_tpu.obs.log import get_logger
 from sirius_tpu.obs.trace import CAPTURE as obs_trace
 from sirius_tpu.utils import checksums as _cks
+from sirius_tpu.utils import devfail
 from sirius_tpu.utils import faults
 from sirius_tpu.utils.profiler import counters, profile, timer_report
 
@@ -69,6 +70,9 @@ _FORECAST_ITERS = obs_metrics.REGISTRY.gauge(
 _FORECAST_WARNING = obs_metrics.REGISTRY.gauge(
     "scf_forecast_warning",
     "divergence early-warning score in [0, 1] (obs/forecast.py)")
+_STRAGGLER = obs_metrics.REGISTRY.counter(
+    "scf_straggler_preempts_total",
+    "runs preempted at a snapshot boundary by the straggler watchdog")
 
 
 def _h_o_diag(ctx: SimulationContext, ik: int, v0: float, dmat: np.ndarray):
@@ -671,13 +675,20 @@ def _run_scf_inner(
     # unpolarized no-U regime, like gshard. ----
     bchunk = None
     bc_flag = cfg.control.beta_chunked
-    if (
+    bc_foot = ctx.beta.num_beta_total * ctx.gkvec.ngk_max * 16
+    # regime eligibility, captured separately from the budget decision: the
+    # OOM degradation ladder (_recover "device_oom" below) engages the
+    # chunked path mid-run after an HBM exhaustion, even when the budget
+    # did not trip it at setup. The bchunk dispatch branch precedes
+    # gamma_bands in the band solve, so a mid-run engagement shadows the
+    # packed gamma path cleanly.
+    _bchunk_ok = bool(
         not serial_bands and gsh is None
         and bc_flag not in (False, "false", "off")
         and nk == 1 and ns == 1 and hub is None and paw is None
         and not mgga and ctx.beta.num_beta_total
-    ):
-        bc_foot = ctx.beta.num_beta_total * ctx.gkvec.ngk_max * 16
+    )
+    if _bchunk_ok:
         if bc_flag in (True, "force") or (
             bc_flag == "auto"
             and bc_foot > cfg.control.beta_chunk_budget_bytes
@@ -927,7 +938,7 @@ def _run_scf_inner(
         nonlocal hub_lagrange, um_local, um_nl, e_hub, vhub
         nonlocal paw_res, e_paw_one_el, pot, psi, psi_big, pr, pi
         nonlocal x_packed, tau_g, fused, fused_carry, fused_out, fused_np
-        nonlocal e_prev, res_tol
+        nonlocal e_prev, res_tol, bchunk, evals
         if os.environ.get("SIRIUS_TPU_DUMP_DIVERGED"):
             np.savez(
                 os.environ["SIRIUS_TPU_DUMP_DIVERGED"],
@@ -937,6 +948,10 @@ def _run_scf_inner(
         d = sup.recover(sentinel, it, detail=detail, state={
             "mixer_beta": mixer.beta, "mixer_kind": mixer.kind,
             "device_scf": fused is not None,
+            # OOM-ladder applicability flags (dft/recovery.py _recover_oom)
+            "beta_chunked": bchunk is not None,
+            "beta_chunk_eligible": _bchunk_ok,
+            "beta_chunk_can_halve": int(cfg.control.beta_chunk_size) > 16,
         })
         if cfg.control.verbosity >= 1:
             logger.warning(
@@ -984,10 +999,32 @@ def _run_scf_inner(
         if gsh is not None:
             gsh["psi"] = None
         psi_big = _initial_subspace(ctx)
+        # the band-solve branches that rebind evals leave a read-only view
+        # of a device array behind; the in-place writers (chunked/gamma
+        # paths) the ladder may switch to need a writable buffer
+        evals = np.array(evals)
+        if d.shrink_beta_budget:
+            # OOM-ladder rung 0 (repeatable): quarter the dense-beta
+            # engagement budget to below the current table's footprint and
+            # halve the chunk size, so the next band solve allocates
+            # strictly less HBM than the one that exhausted it
+            cfg.control.beta_chunk_budget_bytes = min(
+                float(cfg.control.beta_chunk_budget_bytes) / 4.0,
+                bc_foot / 2.0)
+            cfg.control.beta_chunk_size = max(
+                16, int(cfg.control.beta_chunk_size) // 2)
+        if (d.shrink_beta_budget or d.force_beta_chunked) and _bchunk_ok \
+                and (d.force_beta_chunked or bchunk is not None
+                     or bc_foot > cfg.control.beta_chunk_budget_bytes):
+            # (re)engage the chunked projector path; params rebuild lazily
+            # at the next band solve (dtype mismatch forces make_chunked_hk
+            # at the new beta_chunk_size)
+            bchunk = {"params": None, "dtype": None}
         if fused is not None:
-            if d.disable_device:
+            if d.disable_device or bchunk is not None:
                 # rung 2: remaining iterations on the host path, which
-                # re-validates every field per iteration
+                # re-validates every field per iteration (the chunked
+                # projector path also runs under the host loop)
                 fused = None
                 fused_carry = fused_out = fused_np = None
             else:
@@ -1098,6 +1135,59 @@ def _run_scf_inner(
                     sec_per_iteration=per_it)
                 _fc_deadline_ok = ok
 
+    # ---- straggler watchdog (utils/devfail.py): per-iteration wall
+    # against BOTH the run's own healthy-median baseline and the
+    # obs/costs.py analytic model for scf.iteration. A slice degraded by
+    # thermal throttling or a sick neighbor chip runs every iteration
+    # slow; a sustained streak preempts the run at a snapshot boundary so
+    # the serving layer can reschedule it on healthy hardware
+    # (serve/scheduler.py treats StragglerPreempt as a preemption, never a
+    # strike). control.straggler_detect "auto" keeps it OFF standalone —
+    # the scheduler resolves it to on at job admission. ----
+    _strag_on = getattr(cfg.control, "straggler_detect", "auto") in (
+        True, "true", "on", "force")
+    _strag_ratio = float(getattr(cfg.control, "straggler_ratio", 4.0))
+    _strag_iters = max(1, int(getattr(cfg.control, "straggler_iters", 3)))
+    _strag = {"healthy": [], "streak": 0, "fire": False, "delay": 0.0}
+    _c_it = _stage_costs.get("scf.iteration")
+    _strag_model_s = (
+        _c_it.flops / (obs_costs.peak_gflops() * 1e9) if _c_it else 0.0)
+
+    def _straggler_tick(it, dt, path):
+        """Feed one iteration wall clock to the straggler detector."""
+        if not _strag_on or _strag["fire"]:
+            return
+        if it - it0 < 2:
+            return  # compile-dominated warm-up walls are not evidence
+        healthy = _strag["healthy"]
+        if len(healthy) >= 3:
+            tail = sorted(healthy[-12:])
+            base = max(tail[len(tail) // 2], _strag_model_s)
+            if dt > _strag_ratio * base:
+                _strag["streak"] += 1
+                if _strag["streak"] >= _strag_iters:
+                    _strag["fire"] = True
+                    obs_events.emit(
+                        "straggler", it=it + 1, path=path, dt=dt,
+                        baseline_s=base, model_s=_strag_model_s,
+                        ratio=dt / base, streak=_strag["streak"])
+                return
+        _strag["streak"] = 0
+        healthy.append(float(dt))
+
+    def _straggler_preempt(it):
+        """After the detector fired: force a snapshot unless this
+        iteration already autosaved, then hand the run back to the
+        scheduler as a preemption (resume elsewhere from the autosave)."""
+        if not _strag["fire"]:
+            return
+        if not (_autosave_every and (it + 1) % _autosave_every == 0):
+            _autosave(it)
+        _STRAGGLER.inc()
+        raise devfail.StragglerPreempt(
+            f"straggler watchdog preempted the run at iteration {it + 1}: "
+            f"sustained slow iterations on this slice")
+
     obs_events.emit(
         "run_manifest", nk=nk, ns=ns, nb=nb, ng=ng,
         num_atoms=ctx.unit_cell.num_atoms, device_scf=fused is not None,
@@ -1112,6 +1202,32 @@ def _run_scf_inner(
     for it in range(it0, p.num_dft_iter):
         obs_trace.tick()
         _it_t0 = time.time()
+        # ---- injectable device faults at the jit-dispatch boundary
+        # (utils/faults.py fire/armed; tools/chaos_serve.py device phases).
+        # device.oom is classified (utils/devfail.py) and routed through
+        # the OOM degradation ladder IN-RUN: the run rolls back to the
+        # supervisor snapshot and continues on a smaller memory plan — no
+        # job failure. device.lost is deliberately NOT caught here: a lost
+        # chip takes the whole dispatch down, and only the serving layer
+        # can rebuild a mesh from the surviving devices and resume from
+        # the autosave. ----
+        try:
+            faults.fire("device.oom", it)
+        except RuntimeError as _de:
+            if devfail.classify(_de) != "oom":
+                raise
+            _recover("device_oom", detail=str(_de))
+            continue
+        faults.fire("device.lost", it)
+        if _strag_on and faults.armed("device.straggler", it):
+            # persistent slowdown from this iteration on — sized off the
+            # run's own healthy walls so the detector's ratio bar is
+            # crossed regardless of deck size
+            _h = sorted(_strag["healthy"])
+            _base = _h[len(_h) // 2] if _h else 0.1
+            _strag["delay"] = max(0.45, (_strag_ratio + 2.0) * _base)
+        if _strag["delay"]:
+            time.sleep(_strag["delay"])
         # --- band solve per (k, spin) (warm start) ---
         if fused is None or fused_out is None:
             # host D/v0 from the host potential; once the fused step has
@@ -1725,6 +1841,7 @@ def _run_scf_inner(
                 _recover(sentinel)
                 continue
             _forecast_tick(it, _it_dt, "fused")
+            _straggler_tick(it, _it_dt, "fused")
             if sup.enabled and (it % _snap_every == 0
                                 or sup.should_snapshot()):
                 # rollback snapshot: fetch the mixed vector from the carry
@@ -1755,6 +1872,7 @@ def _run_scf_inner(
             if de < p.energy_tol and dens_metric < p.density_tol:
                 converged = True
                 break
+            _straggler_preempt(it)
             continue
 
         # --- occupations ---
@@ -2054,6 +2172,7 @@ def _run_scf_inner(
             _recover(sentinel)
             continue
         _forecast_tick(it, _it_dt, "host")
+        _straggler_tick(it, _it_dt, "host")
         # in-loop precision-headroom probes (obs/numerics.py): shadow
         # re-execution of the post-band stages at degraded precision on
         # the current iterate, every numerics_probe_every iterations
@@ -2098,6 +2217,7 @@ def _run_scf_inner(
         if de < p.energy_tol and dens_metric < p.density_tol:
             converged = True
             break
+        _straggler_preempt(it)
 
     obs_trace.finish()
     # --- final report ---

@@ -25,6 +25,17 @@ path and atomically os.replace()d over the target, so a kill mid-save never
 leaves a corrupt or half-written checkpoint — the previous snapshot stays
 loadable. Loads verify a sha256 over every dataset and raise
 CheckpointError naming the field that failed validation.
+
+Mesh-shape-agnostic contract: every array that enters a checkpoint goes
+through ``np.asarray`` (a full host gather), and nothing about the device
+mesh — device count, (k, b) factorization, sharding specs — is part of
+the layout. Validity is keyed on the *physics* (G-set Miller indices +
+lattice), so an /scf autosave written by a run on N devices resumes
+bit-compatibly on any other mesh, including a single survivor. The serve
+layer's device-loss recovery (serve/supervisor.py degrade_slice) depends
+on this: it shrinks a slice to its surviving devices and resumes the job
+from the same autosave with no translation step. Do not add
+device-topology-dependent fields to the schema without a resharding path.
 """
 
 from __future__ import annotations

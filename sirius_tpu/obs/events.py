@@ -30,6 +30,12 @@ Event kinds emitted across the tree:
 - ``backoff``        — serve retry backoff: delay_s, attempt, failure_class
 - ``watchdog_fire``  — slice watchdog detection (kind=crash|hang)
 - ``worker_restart`` — slice worker respawned (reason, generation)
+- ``slice_degraded`` — a serve slice marked degraded after a device-level
+  failure: reason=device_lost|straggler|oom, surviving device count,
+  cooldown (serve/supervisor.py)
+- ``straggler``      — run_scf's straggler detector fired: iteration,
+  wall vs healthy-median baseline and obs/costs.py model seconds
+  (dft/scf.py; the run preempts at the next snapshot boundary)
 - ``quarantine``     — job permanently failed as poison (strikes)
 - ``journal_replay`` / ``journal_replay_job`` — jobs re-submitted from the
   durable job journal after a restart (serve/journal.py)
@@ -89,7 +95,9 @@ KNOWN_EVENT_KINDS = (
     "scf_done",
     "scf_forecast",
     "scf_iteration",
+    "slice_degraded",
     "span",
+    "straggler",
     "trace_capture",
     "watchdog_fire",
     "worker_restart",

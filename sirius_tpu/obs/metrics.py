@@ -366,6 +366,7 @@ KNOWN_METRIC_NAMES = (
     "scf_iterations_total",
     "scf_recoveries_total",
     "scf_runs_total",
+    "scf_straggler_preempts_total",
     "serve_cache_exec_total",
     "serve_cache_jobs_total",
     "serve_job_failures_total",
@@ -375,6 +376,7 @@ KNOWN_METRIC_NAMES = (
     "serve_journal_replays_total",
     "serve_quarantines_total",
     "serve_queue_rejected_total",
+    "serve_slice_degraded_total",
     "serve_watchdog_fires_total",
     "serve_worker_restarts_total",
     # gauges

@@ -162,7 +162,7 @@ class ServeEngine:
         return job
 
     def _find_replay_autosave(self, job: Job) -> str | None:
-        from sirius_tpu.io.checkpoint import find_resumable
+        from sirius_tpu.io.checkpoint import CheckpointError, find_resumable
 
         ctl = {}
         if isinstance(job.deck, dict):
@@ -174,7 +174,11 @@ class ServeEngine:
             f"sirius_autosave.{ctl.get('autosave_tag') or job.id}.h5")
         try:
             return find_resumable(base, keep=self.autosave_keep)
-        except Exception:
+        except (CheckpointError, OSError):
+            # only the two ways probing an autosave legitimately fails:
+            # damaged/mismatched file or filesystem trouble — a cold
+            # replay is the right degradation for both. Anything else
+            # (incl. a device-class error) must surface, not be eaten.
             return None
 
     @property

@@ -145,6 +145,10 @@ class Job:
         self.quarantined = False  # poisoned: killed/stalled its workers
         self.attempts = 0
         self.poison_strikes = 0  # watchdog strikes (crash/hang) against it
+        # OOM degradation level (utils/devfail.py apply_oom_hint): bumped
+        # by the scheduler when an attempt dies of HBM exhaustion below
+        # the in-run ladder's reach; the next attempt starts pre-degraded
+        self.oom_degrade = 0
         self.resume_path: str | None = None  # autosave to resume from
         self.not_before: float | None = None  # backoff bar honored by pop()
         self.submitted_at: float | None = None
@@ -194,8 +198,22 @@ class Job:
             for hook in list(self._terminal_hooks):
                 try:
                     hook(self)
-                except Exception:
-                    logger.exception("job %s terminal hook failed", self.id)
+                except Exception as e:
+                    # deliberately broad: the remaining hooks and
+                    # _done.set() below MUST still run (a raising hook
+                    # would strand wait_all() forever) — but a
+                    # device-class error surfacing in a hook is hardware
+                    # news, escalated instead of drowned in a traceback
+                    from sirius_tpu.utils import devfail
+
+                    cls = devfail.classify(e)
+                    if cls in ("oom", "device_lost"):
+                        logger.critical(
+                            "job %s terminal hook hit a device-class "
+                            "failure (%s): %s", self.id, cls, e)
+                    else:
+                        logger.exception(
+                            "job %s terminal hook failed", self.id)
             self._done.set()
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -224,6 +242,7 @@ class Job:
             "priority": self.priority,
             "attempts": self.attempts,
             "poison_strikes": self.poison_strikes,
+            "oom_degrade": self.oom_degrade,
             "latency_s": self.latency,
             "error": self.error,
             "permanent": self.permanent,

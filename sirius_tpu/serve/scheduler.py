@@ -18,10 +18,22 @@ Failure classification:
                CheckpointError (bad autosave: the resume path is cleared
                first, class ``bad_checkpoint``), OSError (class ``io``),
                plus watchdog hand-backs (class ``crash``/``hang``).
+  device     -> backend errors classified by the utils/devfail.py
+               taxonomy instead of falling into the permanent catch-all:
+               ``oom`` retries with a degradation hint (the next attempt
+               runs on a smaller memory plan — apply_oom_hint),
+               ``device_lost`` shrinks the slice to its surviving
+               devices and resumes from autosave on the smaller mesh,
+               ``straggler`` (StragglerPreempt from run_scf's watchdog)
+               parks the slice behind a cooldown so the retry lands on
+               healthy hardware, ``transient`` plain-retries. All are
+               preemption semantics: device evidence is against the
+               hardware, never a poison strike against the deck.
   permanent  -> failed, never retried: UpfParseError and other
                ValueError/NotImplementedError/KeyError deck problems —
-               re-running bad input cannot succeed — and poison
-               quarantine (serve/supervisor.py).
+               re-running bad input cannot succeed — unclassifiable
+               unexpected exceptions, and poison quarantine
+               (serve/supervisor.py).
 
 Workers are supervised (serve/supervisor.py): they heartbeat every poll
 cycle, register the job they run, and are respawned by the watchdog when
@@ -58,6 +70,7 @@ from sirius_tpu.obs.log import get_logger, job_context
 from sirius_tpu.serve import cache as cache_mod
 from sirius_tpu.serve.queue import Job, JobQueue, JobStatus
 from sirius_tpu.serve.supervisor import SliceSupervisor
+from sirius_tpu.utils import devfail
 from sirius_tpu.utils import faults
 from sirius_tpu.utils.profiler import counters
 
@@ -150,7 +163,8 @@ class SliceScheduler:
                  job_wall_time_budget: float | None = None,
                  watchdog_interval: float = 0.25,
                  backoff_base: float = 0.5, backoff_max: float = 30.0,
-                 backoff_jitter: float = 0.1):
+                 backoff_jitter: float = 0.1,
+                 straggler_cooldown: float = 5.0):
         import jax
 
         self.queue = queue
@@ -169,6 +183,7 @@ class SliceScheduler:
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
         self.backoff_jitter = float(backoff_jitter)
+        self.straggler_cooldown = float(straggler_cooldown)
         self.supervisor = SliceSupervisor(
             self, poison_threshold=poison_threshold,
             job_wall_time_budget=job_wall_time_budget,
@@ -188,6 +203,13 @@ class SliceScheduler:
         sup = self.supervisor
         while True:
             sup.beat(idx)
+            if not sup.slice_available(idx):
+                # degradation cooldown (straggler): leave queued work to
+                # the healthy slices until the deadline passes
+                if self.queue.closed and len(self.queue) == 0:
+                    return
+                time.sleep(0.05)
+                continue
             job = self.queue.pop(timeout=0.5)
             if job is None:
                 if self.queue.closed and len(self.queue) == 0:
@@ -285,6 +307,7 @@ class SliceScheduler:
         from sirius_tpu.dft.scf import run_scf
         from sirius_tpu.io.checkpoint import CheckpointError
         from sirius_tpu.io.upf import UpfParseError
+        from sirius_tpu.utils.devfail import StragglerPreempt
         from sirius_tpu.utils.faults import SimulatedKill
 
         cfg = None
@@ -317,6 +340,19 @@ class SliceScheduler:
                 # deadline_feasibility events against this bound as its
                 # iterations-to-converge forecast evolves (obs/forecast.py)
                 cfg.control.deadline_ts = float(job.deadline)
+            if cfg.control.straggler_detect == "auto":
+                # straggler watchdog on by default under serve only: a
+                # slow slice preempts the run at a snapshot boundary and
+                # the retry resumes on healthy hardware (dft/scf.py)
+                cfg.control.straggler_detect = True
+            if job.oom_degrade:
+                # a previous attempt died of HBM exhaustion below the
+                # in-run ladder's reach: start this one pre-degraded
+                applied = devfail.apply_oom_hint(
+                    cfg.control, job.oom_degrade)
+                logger.warning(
+                    "job %s retrying at OOM degradation level %d: %s",
+                    job.id, job.oom_degrade, ",".join(applied))
             ctx = build_job_context(cfg, job.base_dir)
             key = cache_mod.bucket_key(cfg, ctx)
             warm = self.cache.note_job(key)
@@ -429,6 +465,15 @@ class SliceScheduler:
                 f"E={result['energy']['total']:.10f} "
                 f"compiled={compiled}",
             )
+        except StragglerPreempt as e:
+            # before SimulatedKill: StragglerPreempt subclasses it. The
+            # slice, not the deck, is slow — park it behind a cooldown so
+            # the retry lands on healthy hardware; never a strike.
+            if self._stale(job, epoch):
+                return
+            self.supervisor.degrade_slice(
+                slice_idx, "straggler", cooldown=self.straggler_cooldown)
+            self._retry(job, cfg, f"straggler preempt: {e}", "straggler")
         except SimulatedKill as e:
             if self._stale(job, epoch):
                 return
@@ -453,7 +498,16 @@ class SliceScheduler:
         except ScfAbortError as e:
             if self._stale(job, epoch):
                 return
-            self._retry(job, cfg, f"scf aborted: {e}", "scf_abort")
+            if e.diagnostic.get("sentinel") == "device_oom":
+                # the in-run OOM ladder ran out of rungs: retry under the
+                # ``oom`` class with the same rungs pre-applied, so the
+                # next attempt starts on the smaller memory plan instead
+                # of re-climbing the ladder from scratch
+                job.oom_degrade = min(job.oom_degrade + 1, 3)
+                self._retry(job, cfg, f"scf aborted on device OOM: {e}",
+                            "oom")
+            else:
+                self._retry(job, cfg, f"scf aborted: {e}", "scf_abort")
         except OSError as e:
             if self._stale(job, epoch):
                 return
@@ -461,8 +515,28 @@ class SliceScheduler:
         except Exception as e:  # a serving worker must outlive any job
             if self._stale(job, epoch):
                 return
-            self._fail(job, f"unexpected {type(e).__name__}: {e}",
-                       permanent=True)
+            cls = devfail.classify(e)
+            if cls == "oom":
+                # HBM exhaustion that unwound past run_scf's in-run ladder
+                # (e.g. from inside a compiled program): retry with a
+                # degradation hint so the next attempt starts on a
+                # smaller memory plan (devfail.apply_oom_hint above)
+                job.oom_degrade = min(job.oom_degrade + 1, 3)
+                self._retry(job, cfg, f"device OOM: {e}", "oom")
+            elif cls == "device_lost":
+                # hardware evidence against the slice, not the job:
+                # shrink the slice to its surviving devices and resume
+                # from autosave on the smaller mesh — preemption
+                # semantics, never a poison strike
+                self.supervisor.degrade_slice(
+                    slice_idx, "device_lost", drop_devices=1)
+                self._retry(job, cfg, f"device lost: {e}", "device_lost")
+            elif cls == "transient":
+                self._retry(job, cfg, f"transient backend error: {e}",
+                            "transient")
+            else:
+                self._fail(job, f"unexpected {type(e).__name__}: {e}",
+                           permanent=True)
 
     def _backoff_delay(self, job: Job) -> float:
         """Exponential backoff with jitter, clamped so the retry can never

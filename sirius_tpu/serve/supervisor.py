@@ -27,10 +27,22 @@ analog of a poison-pill message queue. Strikes are tracked separately
 from ``job.attempts`` so an honest preemption retry is never conflated
 with evidence of a hostile deck.
 
+**Slice degradation** (utils/devfail.py device-fault taxonomy): a
+device-level failure is hardware evidence against the *slice*, not the
+job, so it never strikes. ``degrade_slice`` marks the slice degraded —
+on ``device_lost`` it additionally rebuilds the slice's device list in
+place from the surviving devices (the worker thread holds a reference to
+that list object, so the next job dispatches on the shrunk mesh), and on
+``straggler`` it parks the slice behind a cooldown so the retried job
+lands on healthy hardware first. ``slice_available`` gates the worker's
+queue poll on that cooldown (bypassed for single-slice fleets, where
+waiting would just idle the only capacity).
+
 Everything the supervisor does is observable: ``serve_watchdog_fires_total``
 (kind=crash|hang), ``serve_worker_restarts_total`` (reason),
-``serve_quarantines_total``, plus ``watchdog_fire`` / ``worker_restart``
-/ ``quarantine`` JSONL events.
+``serve_quarantines_total``, ``serve_slice_degraded_total`` (reason),
+plus ``watchdog_fire`` / ``worker_restart`` / ``quarantine`` /
+``slice_degraded`` JSONL events.
 """
 
 from __future__ import annotations
@@ -41,6 +53,7 @@ import time
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs.log import get_logger
+from sirius_tpu.utils import devfail
 
 logger = get_logger("serve")
 
@@ -50,6 +63,9 @@ _RESTARTS = obs_metrics.REGISTRY.counter(
     "serve_worker_restarts_total", "slice workers respawned by reason")
 _QUARANTINES = obs_metrics.REGISTRY.counter(
     "serve_quarantines_total", "jobs quarantined as poison")
+_DEGRADED = obs_metrics.REGISTRY.counter(
+    "serve_slice_degraded_total",
+    "slices marked degraded after a device-level failure, by reason")
 
 
 class WorkerState:
@@ -86,6 +102,10 @@ class SliceSupervisor:
         self.workers = [
             WorkerState(i) for i in range(len(scheduler.slices))
         ]
+        # per-slice degradation cooldown deadlines (unix seconds): a slice
+        # past its deadline serves normally; slice_available() gates the
+        # worker queue poll on it
+        self.degraded_until = [0.0] * len(self.workers)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watchdog: threading.Thread | None = None
@@ -150,6 +170,42 @@ class SliceSupervisor:
                 state.job = None
         state.heartbeat = time.time()
 
+    # -- device-fault degradation (utils/devfail.py taxonomy) --------------
+
+    def degrade_slice(self, idx: int, reason: str, *, drop_devices: int = 0,
+                      cooldown: float = 0.0) -> None:
+        """Mark slice ``idx`` degraded after a device-level failure.
+
+        ``drop_devices`` > 0 (device loss) shrinks the slice's device
+        list IN PLACE to the survivors — the worker thread holds a
+        reference to that list object, so its next job dispatches on the
+        shrunk mesh without a respawn (mesh-shape-agnostic checkpoints
+        make the resume transparent). ``cooldown`` (stragglers) parks the
+        slice so the preempted job's retry lands on healthy hardware
+        first. Never strikes the job: hardware evidence is against the
+        slice, not the deck."""
+        with self._lock:
+            devs = self.scheduler.slices[idx]
+            if drop_devices > 0:
+                survivors = devs[:-drop_devices] or devs[:1]
+                devs[:] = survivors
+            if cooldown > 0.0:
+                self.degraded_until[idx] = max(
+                    self.degraded_until[idx], time.time() + cooldown)
+        _DEGRADED.inc(reason=reason)
+        obs_events.emit("slice_degraded", slice=idx, reason=reason,
+                        devices_left=len(devs), cooldown_s=cooldown)
+        logger.error("slice %d degraded (%s): %d device(s) left, "
+                     "cooldown %.1fs", idx, reason, len(devs), cooldown)
+
+    def slice_available(self, idx: int) -> bool:
+        """False while the slice sits out a degradation cooldown (always
+        True for single-slice fleets — parking the only slice would just
+        idle the queue)."""
+        if len(self.workers) <= 1:
+            return True
+        return time.time() >= self.degraded_until[idx]
+
     # -- watchdog ----------------------------------------------------------
 
     def _queue_active(self) -> bool:
@@ -161,9 +217,19 @@ class SliceSupervisor:
             for state in self.workers:
                 try:
                     self._check_worker(state)
-                except Exception:
-                    logger.exception("watchdog check failed for slice %d",
-                                     state.idx)
+                except Exception as e:
+                    # the watchdog thread must survive anything a check
+                    # raises — but a device-class failure surfacing HERE
+                    # (outside any job dispatch) is hardware news that
+                    # must never drown in a generic traceback line
+                    cls = devfail.classify(e)
+                    if cls in ("oom", "device_lost"):
+                        logger.critical(
+                            "device-class failure (%s) in watchdog check "
+                            "for slice %d: %s", cls, state.idx, e)
+                    else:
+                        logger.exception(
+                            "watchdog check failed for slice %d", state.idx)
 
     def _check_worker(self, state: WorkerState) -> None:
         thread = state.thread

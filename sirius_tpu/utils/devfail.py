@@ -1,0 +1,149 @@
+"""Device-failure taxonomy: classify backend runtime errors so the
+serving layer can choose a recovery policy per failure class.
+
+A device-level failure surfaces in JAX as ``XlaRuntimeError`` (a
+``RuntimeError`` subclass raised from jaxlib) whose *message* carries an
+absl status code plus backend detail — the exception type alone says
+nothing about what happened. ``classify`` maps that message (walking the
+``__cause__``/``__context__`` chain, so wrapped dispatch errors still
+classify) onto three classes with distinct recovery semantics:
+
+  ``oom``          HBM ``RESOURCE_EXHAUSTED``: the *program* does not fit.
+                   Retrying identically re-fails identically; the only
+                   useful retry changes the memory plan. In-run, the
+                   ScfSupervisor's OOM degradation ladder (dft/recovery.py)
+                   shrinks the projector budget / forces the chunked beta
+                   path / falls back to the host path, resuming from the
+                   last snapshot. At the job level the scheduler retries
+                   with ``apply_oom_hint`` pre-degrading the controls.
+  ``device_lost``  the chip is gone (preemption, halt, reset): nothing
+                   in-process can recover it. The serve layer marks the
+                   slice degraded, rebuilds its mesh from the surviving
+                   devices, and resumes the job from its autosave on the
+                   shrunk mesh — preemption semantics, never a poison
+                   strike (the deck did nothing wrong).
+  ``transient``    everything else the backend tags retryable
+                   (UNAVAILABLE / DEADLINE_EXCEEDED / CANCELLED / ABORTED
+                   or an otherwise-unrecognized ``XlaRuntimeError``):
+                   plain backoff-retry on the same mesh.
+
+A ``RuntimeError`` with *no* device markers returns ``None`` — an honest
+bug must keep failing the job permanently, not burn retries.
+
+Fault injection: ``utils/faults.py`` sites ``device.oom`` /
+``device.lost`` synthesize errors with the realistic backend message
+text (``faults.fire``), so everything downstream — this classifier, the
+ladder, the mesh-shrink path — is exercised by the exact strings a real
+TPU failure produces. ``device.straggler`` is a flag site consumed by
+run_scf's straggler detector (see StragglerPreempt below).
+"""
+
+from __future__ import annotations
+
+from sirius_tpu.utils.faults import SimulatedKill
+
+CLASSES = ("oom", "device_lost", "transient")
+
+# substring markers, matched case-insensitively against the full
+# exception text. Sources: PJRT/absl status payloads observed from real
+# HBM exhaustion, TPU preemption/halt, and collective timeouts.
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "out of memory",
+    "hbm space",
+    "allocation failure",
+    "failed to allocate",
+)
+_LOST_MARKERS = (
+    "device_lost",
+    "device lost",
+    "device or resource lost",
+    "system has halted",
+    "chip has been disabled",
+    "device is in an error state",
+    "hardware failure",
+    "slice health check failed",
+)
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "cancelled",
+    "aborted",
+    "connection reset",
+)
+# exception type names that mark an error as backend-originated even
+# when the message carries no status code (then: transient)
+_BACKEND_TYPE_NAMES = ("XlaRuntimeError", "PjRtError")
+
+
+class StragglerPreempt(SimulatedKill):
+    """run_scf detected a straggling device (per-iteration wall far above
+    the obs/costs.py model and the run's own healthy baseline) and
+    preempted itself at a snapshot boundary. Subclasses SimulatedKill so
+    any handler treating injected preemptions as retryable keeps working;
+    the scheduler catches it first to degrade the slice and retry the job
+    under the ``straggler`` failure class (no poison strike)."""
+
+
+def _chain(exc: BaseException):
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        yield exc
+        exc = exc.__cause__ or exc.__context__
+
+
+def classify(exc: BaseException | None) -> str | None:
+    """Failure class of a (possibly wrapped) backend error, or None when
+    the exception is not a device failure at all."""
+    if exc is None:
+        return None
+    backend = False
+    text = []
+    for e in _chain(exc):
+        if type(e).__name__ in _BACKEND_TYPE_NAMES:
+            backend = True
+        if isinstance(e, RuntimeError) or backend:
+            text.append(str(e))
+    blob = " | ".join(text).lower()
+    if not blob:
+        return None
+    if any(m in blob for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in blob for m in _LOST_MARKERS):
+        return "device_lost"
+    if any(m in blob for m in _TRANSIENT_MARKERS):
+        return "transient"
+    # an XlaRuntimeError we cannot parse is still a backend error: retry
+    # beats permanently failing a job on e.g. a new status string
+    return "transient" if backend else None
+
+
+def apply_oom_hint(control, level: int) -> list[str]:
+    """Pre-degrade a job's controls before a retry that previously died
+    of HBM OOM — the job-granularity mirror of the in-run degradation
+    ladder (dft/recovery.py OOM_LADDER), applied by serve/scheduler.py.
+
+    level 1: quarter the chunked-beta engagement budget and halve the
+             chunk size (smaller peak projector footprint);
+    level 2: additionally force the chunked beta path;
+    level 3: additionally disable device_scf (host fallback).
+
+    Returns the list of rung names applied (for the retry detail/event).
+    """
+    applied = []
+    lvl = int(level)
+    if lvl >= 1:
+        control.beta_chunk_budget_bytes = float(
+            control.beta_chunk_budget_bytes) / 4.0
+        control.beta_chunk_size = max(
+            16, int(control.beta_chunk_size) // 2)
+        applied.append("shrink_beta_budget")
+    if lvl >= 2 and control.beta_chunked not in (False, "false", "off"):
+        control.beta_chunked = True
+        applied.append("force_beta_chunked")
+    if lvl >= 3:
+        control.device_scf = False
+        applied.append("disable_device_scf")
+    return applied

@@ -44,6 +44,21 @@ Sites currently wired:
                      loads it; the child must detect the damage and
                      fall back to a cold start instead of failing
                      (``iteration`` 0, fires once per armed count)
+  device.oom         synthesize an HBM RESOURCE_EXHAUSTED backend error
+                     at the SCF iteration's jit-dispatch boundary
+                     (``fire``); run_scf routes it through the
+                     supervisor's OOM degradation ladder
+                     (utils/devfail.py classifies it as "oom")
+  device.lost        synthesize a device-loss backend error at the same
+                     boundary; it escapes run_scf to the serving layer,
+                     which degrades the slice, shrinks its mesh to the
+                     surviving devices, and resumes from autosave
+                     (classified "device_lost")
+  device.straggler   flag site: from the armed iteration on, run_scf's
+                     iterations are artificially slowed so the straggler
+                     watchdog (per-iteration wall vs the obs/costs.py
+                     model and the run's healthy baseline) preempts the
+                     run at a snapshot boundary
 
 Plans are process-local (``install``/``clear``) or inherited by child
 processes through the ``SIRIUS_TPU_FAULTS`` environment variable. The env
@@ -89,7 +104,25 @@ KNOWN_SITES = (
     "serve.journal_torn",
     "campaign.node_fail",
     "campaign.handoff_corrupt",
+    "device.oom",
+    "device.lost",
+    "device.straggler",
 )
+
+# realistic backend-error text per device fault site: the exact status
+# strings a real HBM exhaustion / lost chip produces, so
+# utils/devfail.py's classifier and everything downstream see what
+# production would (fire() raises these as RuntimeError — jaxlib's
+# XlaRuntimeError subclasses RuntimeError, and the classifier matches on
+# the status markers, not the concrete type)
+_DEVICE_ERRORS = {
+    "device.oom": (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "17179869184 bytes. [tf-allocator-allocation-error]"),
+    "device.lost": (
+        "INTERNAL: Device or resource lost: the TPU system has halted; "
+        "restart required"),
+}
 
 
 class SimulatedKill(Exception):
@@ -212,6 +245,21 @@ def check(site: str, iteration: int = 0) -> None:
     if action == "exit":
         os._exit(137)
     # nan/flag actions are meaningless here; treat as armed-and-ignored
+
+
+def fire(site: str, iteration: int = 0) -> None:
+    """Fire a device-fault site: raise the synthesized backend error
+    armed at (site, iteration) — the realistic RESOURCE_EXHAUSTED /
+    device-loss status text a real failure produces (``_DEVICE_ERRORS``),
+    as a RuntimeError at the caller's jit-dispatch boundary. 'exit'
+    hard-exits like a chip taking the process down; no-op when unarmed."""
+    action = _take(site, iteration)
+    if action is None:
+        return
+    if action == "exit":
+        os._exit(137)
+    msg = _DEVICE_ERRORS.get(site, f"INTERNAL: injected fault '{site}'")
+    raise RuntimeError(f"{msg} (iteration {iteration})")
 
 
 def corrupt(site: str, iteration: int, arr):

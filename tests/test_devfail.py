@@ -1,0 +1,292 @@
+"""Device-failure taxonomy (utils/devfail.py) and the HBM-OOM degradation
+ladder (dft/recovery.py OOM_LADDER): classification of backend error text,
+job-level degradation hints, rung routing/skip/repeat/abort at the
+supervisor, and fault-injected device.oom / device.straggler runs through
+run_scf — every run must either converge to the unperturbed energy or
+abort/preempt with the documented structured semantics."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sirius_tpu.dft.recovery import (
+    OOM_LADDER, RecoveryDirective, ScfAbortError, ScfSupervisor)
+from sirius_tpu.testing import synthetic_silicon_context
+from sirius_tpu.utils import devfail, faults
+
+pytestmark = pytest.mark.faults
+
+# ------------------------------------------------------------ classify unit
+
+
+def test_classify_oom_from_backend_text():
+    e = RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "17179869184 bytes. [tf-allocator-allocation-error]")
+    assert devfail.classify(e) == "oom"
+    assert devfail.classify(RuntimeError("Failed to allocate HBM space")) \
+        == "oom"
+
+
+def test_classify_device_lost_and_transient():
+    assert devfail.classify(RuntimeError(
+        "INTERNAL: Device or resource lost: the TPU system has halted; "
+        "restart required")) == "device_lost"
+    assert devfail.classify(RuntimeError(
+        "UNAVAILABLE: socket closed: connection reset")) == "transient"
+    assert devfail.classify(RuntimeError(
+        "DEADLINE_EXCEEDED: collective timed out")) == "transient"
+
+
+def test_classify_plain_errors_are_not_device_failures():
+    # an honest bug must fail the job permanently, not burn retries
+    assert devfail.classify(RuntimeError("list index out of range")) is None
+    assert devfail.classify(ValueError("bad deck")) is None
+    assert devfail.classify(None) is None
+
+
+def test_classify_walks_cause_chain():
+    inner = RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+    try:
+        try:
+            raise inner
+        except RuntimeError as e:
+            raise ValueError("dispatch failed") from e
+    except ValueError as wrapped:
+        assert devfail.classify(wrapped) == "oom"
+
+
+def test_classify_unrecognized_backend_error_is_transient():
+    # the exception TYPE marks it backend-originated even when the message
+    # carries no known status string: retry beats failing permanently
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert devfail.classify(
+        XlaRuntimeError("brand-new status string")) == "transient"
+
+
+# ------------------------------------------------------ apply_oom_hint unit
+
+
+class _Ctl:
+    def __init__(self, **kw):
+        self.beta_chunk_budget_bytes = 1 << 30
+        self.beta_chunk_size = 128
+        self.beta_chunked = "auto"
+        self.device_scf = "auto"
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def test_apply_oom_hint_levels_stack():
+    c = _Ctl()
+    assert devfail.apply_oom_hint(c, 1) == ["shrink_beta_budget"]
+    assert c.beta_chunk_budget_bytes == (1 << 30) / 4.0
+    assert c.beta_chunk_size == 64
+    assert c.device_scf == "auto"  # untouched below level 3
+
+    c = _Ctl()
+    assert devfail.apply_oom_hint(c, 3) == [
+        "shrink_beta_budget", "force_beta_chunked", "disable_device_scf"]
+    assert c.beta_chunked is True
+    assert c.device_scf is False
+
+
+def test_apply_oom_hint_respects_chunk_opt_out_and_size_floor():
+    c = _Ctl(beta_chunked="off", beta_chunk_size=16)
+    applied = devfail.apply_oom_hint(c, 2)
+    assert applied == ["shrink_beta_budget"]  # no forcing past an opt-out
+    assert c.beta_chunked == "off"
+    assert c.beta_chunk_size == 16  # floor: never below one tile
+
+
+# ----------------------------------------------- supervisor OOM-ladder unit
+
+
+class _SupCtl:
+    scf_supervision = True
+    max_recoveries = 3
+    rms_divergence_iters = 8
+    energy_blowup_tol = 1e4
+    diag_dump = ""
+
+
+def _sup(max_recoveries=3):
+    ctl = _SupCtl()
+    ctl.max_recoveries = max_recoveries
+    sup = ScfSupervisor(ctl, 0.7, "anderson")
+    sup.snapshot(2, {"x_mix": np.zeros(4)})
+    return sup
+
+
+def test_oom_ladder_rung0_then_repeat_while_chunks_halve():
+    sup = _sup()
+    d = sup.recover("device_oom", 3, state={
+        "beta_chunk_eligible": True, "beta_chunked": False,
+        "beta_chunk_can_halve": True, "device_scf": False})
+    assert isinstance(d, RecoveryDirective)
+    assert d.shrink_beta_budget and not d.force_beta_chunked
+    assert sup.history[-1]["ladder"] == "oom"
+    assert sup.history[-1]["action"] == OOM_LADDER[0]
+    # second OOM on the now-chunked host run: rungs 1/2 are inapplicable
+    # (already chunked, no device path) so rung 0 repeats
+    d2 = sup.recover("device_oom", 6, state={
+        "beta_chunk_eligible": True, "beta_chunked": True,
+        "beta_chunk_can_halve": True, "device_scf": False})
+    assert d2.shrink_beta_budget and d2.rung == 0
+    assert sup.recoveries == 2
+
+
+def test_oom_ladder_skips_inapplicable_rungs():
+    # fused run, chunking disabled: the first rung that changes the memory
+    # plan is disable_device_scf
+    sup = _sup()
+    d = sup.recover("device_oom", 3, state={
+        "beta_chunk_eligible": False, "beta_chunked": False,
+        "beta_chunk_can_halve": False, "device_scf": True})
+    assert d.disable_device and not d.shrink_beta_budget
+    assert sup.history[-1]["action"] == "disable_device_scf"
+
+
+def test_oom_ladder_aborts_when_no_rung_applies():
+    sup = _sup()
+    with pytest.raises(ScfAbortError) as ei:
+        sup.recover("device_oom", 3, state={
+            "beta_chunk_eligible": False, "beta_chunked": True,
+            "beta_chunk_can_halve": False, "device_scf": False})
+    assert ei.value.diagnostic["sentinel"] == "device_oom"
+
+
+def test_oom_ladder_aborts_past_recovery_budget():
+    sup = _sup(max_recoveries=1)
+    state = {"beta_chunk_eligible": True, "beta_chunked": False,
+             "beta_chunk_can_halve": True, "device_scf": True}
+    sup.recover("device_oom", 3, state=state)
+    with pytest.raises(ScfAbortError):
+        sup.recover("device_oom", 5, state=dict(state, beta_chunked=True))
+
+
+def test_oom_ladder_independent_of_divergence_ladder():
+    # a device OOM must not consume a divergence rung, and vice versa
+    sup = _sup()
+    sup.recover("device_oom", 3, state={
+        "beta_chunk_eligible": True, "beta_chunked": False,
+        "beta_chunk_can_halve": True, "device_scf": False})
+    assert sup.oom_rung == 1 and sup.rung == 0
+    d = sup.recover("nonfinite_fields", 5)
+    assert d.flush_history and sup.rung == 1 and sup.oom_rung == 1
+
+
+# --------------------------------------------------- run_scf integration
+
+# tiny deck: 1 k-point, 8 bands, converges in ~12 host iterations
+DECK = dict(
+    gk_cutoff=3.0, pw_cutoff=7.0, ngridk=(1, 1, 1), num_bands=8,
+    ultrasoft=True, use_symmetry=False,
+    extra_params={"num_dft_iter": 40, "density_tol": 5e-9,
+                  "energy_tol": 1e-10},
+)
+
+
+def _run(device_scf="off", plan=None, resume=None, **ctl):
+    from sirius_tpu.dft.scf import run_scf
+
+    ctx = synthetic_silicon_context(**DECK)
+    ctx.cfg.control.device_scf = device_scf
+    for k, v in ctl.items():
+        setattr(ctx.cfg.control, k, v)
+    faults.install(plan or [])
+    return run_scf(ctx.cfg, ctx=ctx, resume=resume)
+
+
+@pytest.fixture(scope="module")
+def e_ref():
+    """Unperturbed host-path total energy of the shared deck."""
+    r = _run("off")
+    assert r["converged"]
+    assert r["recovery"]["recoveries"] == 0
+    return r["energy"]["total"]
+
+
+def test_injected_oom_degrades_and_converges_host(e_ref):
+    """A mid-run HBM OOM (realistic RESOURCE_EXHAUSTED text) on the host
+    path must not fail the run: the ladder shrinks the chunked-beta budget,
+    the run resumes from the snapshot on the chunked path and converges to
+    the unperturbed energy (ISSUE acceptance bar)."""
+    r = _run("off", plan=[("device.oom", 3, "raise")])
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 1
+    h = rec["ladder_history"][0]
+    assert h["ladder"] == "oom"
+    assert h["sentinel"] == "device_oom"
+    assert h["action"] == "shrink_beta_budget"
+    assert "RESOURCE_EXHAUSTED" in h["detail"]
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_double_oom_stays_within_two_rungs(e_ref):
+    """ISSUE acceptance: repeated OOM completes via the ladder with no job
+    failure and no more than two rungs taken."""
+    r = _run("off", plan=[("device.oom", 3, "raise"),
+                          ("device.oom", 6, "raise")])
+    assert r["converged"]
+    rec = r["recovery"]
+    assert 1 <= rec["recoveries"] <= 2
+    assert all(h["ladder"] == "oom" for h in rec["ladder_history"])
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_oom_on_fused_path_falls_back_to_host(e_ref):
+    """Fused run with chunking opted out: the only applicable rung is the
+    host fallback (disable_device_scf) — still converges."""
+    r = _run("auto", plan=[("device.oom", 3, "raise")], beta_chunked="off")
+    assert r["converged"]
+    rec = r["recovery"]
+    assert rec["recoveries"] == 1
+    assert rec["ladder_history"][0]["action"] == "disable_device_scf"
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+def test_oom_with_no_applicable_rung_aborts_structured():
+    """Host path with chunking opted out has no memory plan left to change:
+    the run must abort with the device_oom diagnostic (the serving layer
+    then retries with apply_oom_hint), never loop on the same OOM."""
+    with pytest.raises(ScfAbortError) as ei:
+        _run("off", plan=[("device.oom", 3, "raise")], beta_chunked="off")
+    assert ei.value.diagnostic["sentinel"] == "device_oom"
+
+
+def test_device_lost_propagates_to_caller():
+    """Device loss is NOT recoverable in-process: run_scf must let it
+    unwind (the serve layer owns mesh-shrink + resume)."""
+    with pytest.raises(RuntimeError) as ei:
+        _run("off", plan=[("device.lost", 3, "raise")])
+    assert devfail.classify(ei.value) == "device_lost"
+
+
+def test_straggler_preempts_at_snapshot_boundary_and_resumes(
+        e_ref, tmp_path):
+    """The straggler watchdog must preempt a persistently slow run AT a
+    snapshot boundary (StragglerPreempt after a forced autosave) so the
+    retry resumes elsewhere instead of restarting — and the resumed run
+    converges to the unperturbed energy."""
+    ck = str(tmp_path / "auto.h5")
+    with pytest.raises(devfail.StragglerPreempt):
+        _run("off", plan=[("device.straggler", 4, "flag")],
+             straggler_detect=True, autosave_path=ck)
+    faults.clear()
+    assert os.path.exists(ck), "preempted without leaving a resume point"
+    r = _run("off", resume=ck)
+    assert r["converged"]
+    assert r["recovery"]["recoveries"] == 0
+    assert abs(r["energy"]["total"] - e_ref) < 1e-8
+
+
+@pytest.mark.slow
+def test_straggler_detect_auto_is_off_outside_serving():
+    """straggler_detect='auto' resolves to ON only under the serving
+    scheduler (which owns the retry path); a standalone run_scf must not
+    preempt itself even under injected slowness."""
+    r = _run("off", plan=[("device.straggler", 4, "flag")])
+    assert r["converged"]  # flag armed but never consumed

@@ -1,0 +1,136 @@
+"""Serving-layer device-fault policy (devfail taxonomy at the scheduler):
+a device OOM that exhausts the in-run ladder retries the job with a
+degradation hint, device loss shrinks the slice mesh and resumes (never a
+poison strike), and the degrade/cooldown bookkeeping at the supervisor."""
+
+import time
+
+import jax
+import pytest
+
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.serve.engine import ServeEngine
+from sirius_tpu.serve.queue import JobStatus
+from sirius_tpu.utils import faults
+
+requires_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="needs the conftest virtual multi-device CPU mesh",
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guard():
+    from sirius_tpu.testing import LockOrderMonitor
+
+    with LockOrderMonitor(scope="sirius_tpu/serve") as mon:
+        yield mon
+    mon.assert_clean()
+
+
+def make_deck(**control):
+    return {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": [1, 1, 1],
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": 40,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+        "control": {"device_scf": "auto", "ngk_pad_quantum": 16, **control},
+        "synthetic": {"ultrasoft": True},
+    }
+
+
+def _backoffs(path, failure_class):
+    return [e for e in obs_events.read_events(path, kind="backoff")
+            if e["failure_class"] == failure_class]
+
+
+@requires_mesh
+@pytest.mark.faults
+def test_oom_abort_retries_with_degradation_hint(tmp_path):
+    """A deck whose in-run OOM ladder has no rung left (host path, chunking
+    opted out) aborts with the device_oom diagnostic; the scheduler must
+    retry it under the ``oom`` class with oom_degrade bumped so the next
+    attempt starts pre-degraded via apply_oom_hint — and that attempt
+    finishes the job. No poison strike: the deck did nothing wrong."""
+    ev = str(tmp_path / "ev.jsonl")
+    faults.install([("device.oom", 3, "raise")])
+    eng = ServeEngine(num_slices=1, devices=jax.devices()[:2],
+                      workdir=str(tmp_path), backoff_base=0.01,
+                      events_path=ev)
+    eng.start()
+    try:
+        j = eng.submit(make_deck(device_scf="off", beta_chunked="off"),
+                       job_id="oomy", wall_time_budget=300.0)
+        assert j.wait(timeout=240.0), "OOM job never settled"
+        assert j.status == JobStatus.DONE, j.error
+        assert j.attempts == 2
+        assert j.oom_degrade == 1
+        assert j.poison_strikes == 0
+    finally:
+        eng.shutdown(wait=True, mode="abort")
+    assert len(_backoffs(ev, "oom")) == 1
+
+
+@requires_mesh
+@pytest.mark.faults
+def test_device_lost_shrinks_slice_and_resumes(tmp_path):
+    """An injected device loss mid-SCF must degrade the slice to its
+    survivors (mesh shrink IN PLACE — the worker thread keeps serving) and
+    retry the job with preemption semantics: resumed, done, zero strikes."""
+    ev = str(tmp_path / "ev.jsonl")
+    faults.install([("device.lost", 5, "raise")])
+    eng = ServeEngine(num_slices=1, devices=jax.devices()[:2],
+                      workdir=str(tmp_path), backoff_base=0.01,
+                      autosave_every=1, events_path=ev)
+    eng.start()
+    try:
+        j = eng.submit(make_deck(), job_id="lost", wall_time_budget=300.0)
+        assert j.wait(timeout=240.0), "device-lost job never settled"
+        assert j.status == JobStatus.DONE, j.error
+        assert j.attempts == 2
+        assert j.poison_strikes == 0, "device loss must never strike"
+        # the slice itself shrank: the retry ran on the surviving device
+        assert len(eng.scheduler.slices[0]) == 1
+    finally:
+        eng.shutdown(wait=True, mode="abort")
+    assert len(_backoffs(ev, "device_lost")) == 1
+    degraded = obs_events.read_events(ev, kind="slice_degraded")
+    assert [e["reason"] for e in degraded] == ["device_lost"]
+    assert degraded[0]["devices_left"] == 1
+
+
+def test_degrade_cooldown_gates_slice_availability(tmp_path):
+    """degrade_slice with a cooldown parks the slice; slice_available
+    reopens it after the deadline — except on a single-worker engine,
+    where parking the only slice would deadlock the queue."""
+    eng = ServeEngine(num_slices=2, devices=jax.devices()[:2],
+                      workdir=str(tmp_path))
+    sup = eng.scheduler.supervisor
+    try:
+        assert sup.slice_available(0)
+        sup.degrade_slice(0, "straggler", cooldown=30.0)
+        assert not sup.slice_available(0)
+        assert sup.slice_available(1)
+        sup.degraded_until[0] = time.time() - 1.0  # deadline passed
+        assert sup.slice_available(0)
+        # dropping devices never empties a slice
+        sup.degrade_slice(1, "device_lost", drop_devices=5)
+        assert len(eng.scheduler.slices[1]) == 1
+    finally:
+        eng.shutdown(wait=True, mode="abort")
+
+    eng1 = ServeEngine(num_slices=1, workdir=str(tmp_path))
+    try:
+        sup1 = eng1.scheduler.supervisor
+        sup1.degrade_slice(0, "straggler", cooldown=30.0)
+        assert sup1.slice_available(0)  # sole slice: never parked
+    finally:
+        eng1.shutdown(wait=True, mode="abort")

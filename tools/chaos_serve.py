@@ -30,6 +30,23 @@ decks, host SCF path) and attacks it the way production does:
                   nodes with their dependency edges intact, leave the
                   completed nodes untouched, and finalize real Γ
                   frequencies from the handoff artifacts on disk.
+  oom_ladder      two synthesized HBM RESOURCE_EXHAUSTED errors mid-run
+                  (device.oom); run_scf's OOM degradation ladder must
+                  absorb both IN-RUN (shrink the beta budget / engage the
+                  chunked projector path) — the job completes on its
+                  FIRST attempt with no job-level retry and at most two
+                  ladder rungs consumed.
+  device_lost     a synthesized device-loss backend error (device.lost)
+                  escapes run_scf; the scheduler must degrade the slice
+                  to its surviving device (mesh shrink, not a strike) and
+                  resume the job from autosave on the smaller mesh, with
+                  total SCF iterations <= --max-iter-ratio x a fault-free
+                  reference on the full slice.
+  straggler       a slice turns persistently slow mid-run
+                  (device.straggler); run_scf's straggler watchdog must
+                  preempt at a snapshot boundary, the scheduler must park
+                  the slice behind a cooldown, and the job must finish on
+                  the OTHER slice with zero poison strikes.
 
 Usage:
     python tools/chaos_serve.py [--phases a,b,...] [--out CHAOS_BENCH.json]
@@ -137,9 +154,12 @@ def child_main(args) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
+        # --devices overrides for multi-device-per-slice phases
+        # (device_lost needs a slice with a device to lose)
+        ndev = max(args.devices or args.slices, 1)
         os.environ["XLA_FLAGS"] = (
             flags +
-            f" --xla_force_host_platform_device_count={max(args.slices, 1)}"
+            f" --xla_force_host_platform_device_count={ndev}"
         ).strip()
 
     import threading
@@ -241,13 +261,14 @@ def spawn_child(wd: str, mode: str, jobs: int, slices: int,
                 budget_first: bool = False,
                 poison: int = 2, max_retries: int = 2,
                 backoff_base: float = 0.05,
-                timeout: float = 300.0) -> subprocess.Popen:
+                timeout: float = 300.0,
+                devices: int = 0) -> subprocess.Popen:
     os.makedirs(wd, exist_ok=True)
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--workdir", wd, "--mode", mode, "--jobs", str(jobs),
            "--slices", str(slices), "--max-retries", str(max_retries),
            "--poison", str(poison), "--backoff-base", str(backoff_base),
-           "--timeout", str(timeout)]
+           "--timeout", str(timeout), "--devices", str(devices)]
     if faults:
         validate_fault_spec(faults)
         cmd += ["--faults", faults]
@@ -494,8 +515,121 @@ def phase_campaign_kill(root: str, slices: int) -> dict:
             "finalize_error": camp.get("finalize_error")}
 
 
+def phase_oom_ladder(root: str) -> dict:
+    """Two mid-run HBM exhaustions (device.oom) must be absorbed by
+    run_scf's OOM degradation ladder — resumed from the supervisor
+    snapshot on a smaller memory plan, never surfacing as a job failure:
+    the job completes on its FIRST attempt, <= 2 ladder rungs consumed."""
+    wd = os.path.join(root, "oom")
+    rc = run_child(wd, "submit", jobs=1, slices=1,
+                   faults="device.oom@4:raise,device.oom@8:raise")
+    events = os.path.join(wd, "events.jsonl")
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    job = (res.get("jobs") or [{}])[0]
+    fired = [f for f in res.get("faults_fired", []) if f[0] == "device.oom"]
+    recoveries = [e for e in events_of(events, "recovery")
+                  if e.get("sentinel") == "device_oom"
+                  and e.get("action") != "abort"]
+    oom_backoffs = [e for e in events_of(events, "backoff")
+                    if e.get("failure_class") == "oom"]
+    ok = (rc == 0 and job.get("status") == "done"
+          and job.get("attempts") == 1  # ladder absorbed both, no retry
+          and len(fired) == 2 and 1 <= len(recoveries) <= 2
+          and not oom_backoffs)
+    return {"ok": ok, "rc": rc, "status": job.get("status"),
+            "attempts": job.get("attempts"), "oom_faults_fired": len(fired),
+            "ladder_rungs": [e.get("action") for e in recoveries],
+            "job_level_oom_retries": len(oom_backoffs)}
+
+
+def phase_device_lost(root: str, max_ratio: float) -> dict:
+    """A device-loss backend error (device.lost) escapes run_scf on a
+    2-device slice: the scheduler must shrink the slice to its survivor
+    (slice_degraded, not a poison strike) and resume the job from
+    autosave on the smaller mesh, with total SCF iterations <= max_ratio
+    x a fault-free reference on the full slice."""
+    ref_wd = os.path.join(root, "lost_ref")
+    rc_ref = run_child(ref_wd, "submit", jobs=1, slices=1, devices=2)
+    ref_iters = count_events(os.path.join(ref_wd, "events.jsonl"),
+                             "scf_iteration")
+
+    wd = os.path.join(root, "lost")
+    rc = run_child(wd, "submit", jobs=1, slices=1, devices=2,
+                   faults="device.lost@5:raise")
+    events = os.path.join(wd, "events.jsonl")
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    job = (res.get("jobs") or [{}])[0]
+    degraded = [e for e in events_of(events, "slice_degraded")
+                if e.get("reason") == "device_lost"]
+    lost_backoffs = [e for e in events_of(events, "backoff")
+                     if e.get("failure_class") == "device_lost"]
+    total_iters = count_events(events, "scf_iteration")
+    ratio = (total_iters / ref_iters) if ref_iters else float("inf")
+    ok = (rc_ref == 0 and rc == 0 and job.get("status") == "done"
+          and job.get("attempts") == 2
+          and job.get("poison_strikes", 0) == 0  # preemption, not a strike
+          and len(degraded) == 1 and degraded[0].get("devices_left") == 1
+          and len(lost_backoffs) == 1
+          and ratio <= max_ratio)
+    return {"ok": ok, "rc_ref": rc_ref, "rc": rc,
+            "status": job.get("status"), "attempts": job.get("attempts"),
+            "poison_strikes": job.get("poison_strikes"),
+            "devices_left": (degraded[0].get("devices_left")
+                             if degraded else None),
+            "ref_scf_iterations": ref_iters,
+            "total_scf_iterations": total_iters, "iter_ratio": ratio,
+            "max_iter_ratio": max_ratio}
+
+
+def phase_straggler(root: str) -> dict:
+    """One slice of two turns persistently slow mid-run
+    (device.straggler): run_scf's straggler watchdog must preempt the job
+    at a snapshot boundary, the scheduler must park the slow slice behind
+    a cooldown, and the retry must finish on the OTHER slice — zero
+    poison strikes (slowness is hardware evidence, not a hostile deck)."""
+    wd = os.path.join(root, "straggler")
+    rc = run_child(wd, "submit", jobs=1, slices=2,
+                   faults="device.straggler@4:flag")
+    events = os.path.join(wd, "events.jsonl")
+    res = read_json(os.path.join(wd, "result-submit.json"))
+    job = (res.get("jobs") or [{}])[0]
+    strags = events_of(events, "straggler")
+    degraded = [e for e in events_of(events, "slice_degraded")
+                if e.get("reason") == "straggler"]
+    strag_backoffs = [e for e in events_of(events, "backoff")
+                      if e.get("failure_class") == "straggler"]
+    # each attempt's compiling/running transition detail names its slice
+    # ("slice N, bucket ..."); the degraded slice comes from the
+    # slice_degraded event — the finishing attempt's slice must differ
+    def _slice_of(e):
+        toks = str(e.get("detail", "")).split()
+        try:
+            return int(toks[1].rstrip(",")) if toks[:1] == ["slice"] else None
+        except ValueError:
+            return None
+
+    run_slices = [s for s in (
+        _slice_of(e) for e in events_of(events, "job_transition")
+        if e.get("status") in ("running", "compiling")) if s is not None]
+    slow_slice = degraded[0].get("slice") if degraded else None
+    final_slice = run_slices[-1] if run_slices else None
+    ok = (rc == 0 and job.get("status") == "done"
+          and job.get("attempts") == 2
+          and job.get("poison_strikes", 0) == 0
+          and len(strags) >= 1 and len(degraded) == 1
+          and len(strag_backoffs) == 1
+          and final_slice is not None and final_slice != slow_slice)
+    return {"ok": ok, "rc": rc, "status": job.get("status"),
+            "attempts": job.get("attempts"),
+            "poison_strikes": job.get("poison_strikes"),
+            "straggler_events": len(strags),
+            "degraded_slice": slow_slice, "final_slice": final_slice,
+            "attempt_slices": run_slices}
+
+
 PHASES = ("kill_restart", "crash_respawn", "hang_quarantine",
-          "drain_restart", "backoff", "torn_tail", "campaign_kill")
+          "drain_restart", "backoff", "torn_tail", "campaign_kill",
+          "oom_ladder", "device_lost", "straggler")
 
 
 def main(argv=None) -> int:
@@ -516,6 +650,8 @@ def main(argv=None) -> int:
     ap.add_argument("--max-retries", type=int, default=2)
     ap.add_argument("--backoff-base", type=float, default=0.05)
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="child: XLA host device count (0 = one per slice)")
     ap.add_argument("--phases", default=",".join(PHASES),
                     help="comma-separated subset of: " + ",".join(PHASES))
     ap.add_argument("--max-iter-ratio", type=float, default=1.5,
@@ -555,6 +691,12 @@ def main(argv=None) -> int:
             res = phase_backoff(root)
         elif name == "campaign_kill":
             res = phase_campaign_kill(root, args.slices)
+        elif name == "oom_ladder":
+            res = phase_oom_ladder(root)
+        elif name == "device_lost":
+            res = phase_device_lost(root, args.max_iter_ratio)
+        elif name == "straggler":
+            res = phase_straggler(root)
         else:
             res = phase_torn_tail(root)
         res["wall_s"] = time.time() - tp
