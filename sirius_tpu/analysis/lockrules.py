@@ -39,7 +39,14 @@ from sirius_tpu.analysis.core import (
     dotted_name,
 )
 
-SCOPE_SUBSTR = "serve/"
+# path fragments whose modules are in lock-analysis scope: the serving
+# layer plus the fleet federation built on it (ISSUE 19) — fleet locks
+# nest under serve/queue locks, so the order graph must span both
+SCOPE_SUBSTRS = ("serve/", "fleet/")
+
+
+def _in_scope(relpath: str) -> bool:
+    return any(s in relpath for s in SCOPE_SUBSTRS)
 
 _LOCK_CTORS = {"threading.Lock": "lock", "Lock": "lock",
                "threading.RLock": "rlock", "RLock": "rlock"}
@@ -116,7 +123,7 @@ class _Model:
     def __init__(self, project: ProjectIndex):
         self.project = project
         self.modules = [mi for mi in project.modules.values()
-                        if SCOPE_SUBSTR in mi.fctx.relpath]
+                        if _in_scope(mi.fctx.relpath)]
         self.classes: dict[str, _ClassModel] = {}
         self.module_locks: dict[tuple[str, str], str] = {}  # id -> kind
         for mi in self.modules:
@@ -342,7 +349,7 @@ class _Analysis:
                     return [tgt]
         # plain / imported function
         for tgt in self.m.project._resolve_call(fi.module, fi.cls, d):
-            if SCOPE_SUBSTR in tgt.module.fctx.relpath:
+            if _in_scope(tgt.module.fctx.relpath):
                 out.append(tgt)
         return out
 

@@ -1,0 +1,35 @@
+"""sirius_tpu.fleet: multi-engine federation for the serving layer.
+
+Three pieces turn one ServeEngine process into a fleet:
+
+- ``canon`` — canonical deck hashing: a deck dict is normalized (sorted
+  keys, float normalization, site-order canonicalization, execution
+  policy stripped) and hashed, so physically identical requests share
+  one content address regardless of dict order or float spelling.
+- ``store`` — a durable content-addressed result store: converged
+  energies/forces plus the donor trace id, written atomically (tmp +
+  rename + fsync, the PR-8 write-ahead discipline), so an exact
+  resubmission anywhere in the fleet is answered from disk instead of
+  a TPU.
+- ``federation`` — a shared filesystem queue directory N engine
+  processes lease work from: fsync'd atomic lease claim (O_EXCL),
+  heartbeat renewal, expiry reclaim. A SIGKILL'd engine's leases expire
+  and a survivor resumes its jobs from their job-scoped autosaves,
+  continuing the original trace ids.
+
+The in-engine halves — watcher attachment for concurrent identical
+submissions and per-tenant fair-share popping — live in serve/queue.py
+and serve/engine.py.
+"""
+
+from sirius_tpu.fleet.canon import canonical_deck, deck_hash
+from sirius_tpu.fleet.federation import FleetDir, FleetMember
+from sirius_tpu.fleet.store import ResultStore
+
+__all__ = [
+    "FleetDir",
+    "FleetMember",
+    "ResultStore",
+    "canonical_deck",
+    "deck_hash",
+]

@@ -1,0 +1,138 @@
+"""Canonical deck hashing: the content address of a physics request.
+
+Two submissions that describe the same calculation must hash the same
+even when the JSON around them differs, and two different calculations
+must never collude on one address. ``canonical_deck`` normalizes a deck
+dict into a form where equality is physical equality (to float
+round-off), and ``deck_hash`` is its sha256.
+
+Normalization rules (each is load-bearing for a dedup hit):
+
+- **Key order.** Dicts are serialized with sorted keys at every level —
+  ``{"a":1,"b":2}`` and ``{"b":2,"a":1}`` are the same request.
+- **Float spelling.** Every numeric scalar is normalized through
+  ``float`` and rounded to 12 significant digits: ``1`` vs ``1.0`` vs
+  ``1.0000000000000002`` hash identically, while anything differing
+  above 1e-12 relative — a real physics difference — does not.
+  Booleans are kept distinct from 0/1 (they are type markers, not
+  magnitudes).
+- **Site order.** An atom list is a set, not a sequence: any key named
+  ``positions`` holding a list of numeric rows is sorted (paired with a
+  sibling ``species``/``atoms`` label list when present, so labels
+  travel with their coordinates). Two decks listing the same atoms in a
+  different order are the same crystal.
+- **Execution policy is not physics.** The ``control`` section
+  (autosave paths, device counts, telemetry, deadlines) is stripped
+  before hashing: it changes how a run executes, never what it
+  converges to, and including it would shatter the memo space across
+  serving configurations.
+
+The hash deliberately does NOT try to detect deeper physical
+equivalences (supercell re-labelings, symmetry-equivalent rotations):
+a canonicalization that is too clever risks conflating decks that are
+*not* identical, and a missed dedup is merely slow while a wrong dedup
+is a wrong answer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# deck sections that change execution, not the converged answer — never
+# part of the content address (see module docstring)
+EXECUTION_SECTIONS = ("control",)
+
+# per-atom label keys that must be permuted together with "positions"
+_SITE_LABEL_KEYS = ("species", "atoms", "atom_types")
+
+
+def _num(v):
+    """Normalize a numeric scalar: 12 significant digits, int when
+    integral (so 1, 1.0 and 1.0+1e-15 all canonicalize to 1)."""
+    f = float(f"{float(v):.12g}")
+    if f.is_integer() and abs(f) < 1e15:
+        return int(f)
+    return f
+
+
+def _is_numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _is_position_rows(v) -> bool:
+    """A non-empty list of equal-length numeric rows (fractional or
+    cartesian site coordinates)."""
+    if not isinstance(v, list) or not v:
+        return False
+    width = None
+    for row in v:
+        if not isinstance(row, list) or not row:
+            return False
+        if not all(_is_numeric(x) for x in row):
+            return False
+        if width is None:
+            width = len(row)
+        elif len(row) != width:
+            return False
+    return True
+
+
+def _canon_sites(d: dict) -> dict:
+    """Sort the rows of ``d["positions"]`` (site order is not physics),
+    carrying any parallel per-atom label list along with its row."""
+    rows = [[_num(x) for x in row] for row in d["positions"]]
+    label_key = next(
+        (k for k in _SITE_LABEL_KEYS
+         if isinstance(d.get(k), list) and len(d[k]) == len(rows)),
+        None)
+    if label_key is None:
+        d["positions"] = sorted(rows)
+        return d
+    paired = sorted(zip(d[label_key], rows), key=lambda p: (str(p[0]), p[1]))
+    d[label_key] = [p[0] for p in paired]
+    d["positions"] = [p[1] for p in paired]
+    return d
+
+
+def _canon(v, top: bool = False):
+    if isinstance(v, dict):
+        out = {}
+        for k in sorted(v):
+            if top and k in EXECUTION_SECTIONS:
+                continue
+            out[str(k)] = _canon(v[k])
+        if _is_position_rows(out.get("positions")):
+            out = _canon_sites(out)
+        return out
+    if isinstance(v, (list, tuple)):
+        return [_canon(x) for x in v]
+    if isinstance(v, bool) or v is None or isinstance(v, str):
+        return v
+    if _is_numeric(v):
+        return _num(v)
+    # arrays and exotic scalars from programmatic decks
+    for attr in ("tolist", "item"):
+        fn = getattr(v, attr, None)
+        if fn is not None:
+            try:
+                return _canon(fn())
+            except Exception:
+                break
+    return str(v)
+
+
+def canonical_deck(deck: dict) -> dict:
+    """The normalized form of ``deck`` whose equality is physical
+    equality; see the module docstring for the rules."""
+    if not isinstance(deck, dict):
+        raise TypeError(f"deck must be a dict, got {type(deck).__name__}")
+    return _canon(deck, top=True)
+
+
+def deck_hash(deck: dict) -> str:
+    """sha256 hex digest of the canonical deck — the content address
+    used by the result store, watcher attachment, and fleet dedup."""
+    blob = json.dumps(canonical_deck(deck), sort_keys=True,
+                      separators=(",", ":"), ensure_ascii=True)
+    return hashlib.sha256(blob.encode("ascii")).hexdigest()

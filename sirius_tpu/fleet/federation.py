@@ -1,0 +1,424 @@
+"""Multi-host federation: N engine processes lease work from one shared
+queue directory.
+
+``FleetDir`` is the on-disk protocol — a journal-backed queue directory
+any number of clients submit into and any number of engines pull from::
+
+    root/
+      jobs/<job_id>.json      durable submit record (deck + metadata)
+      byhash/<hash>.json      canonical-hash -> job_id dedup index
+      leases/<job_id>.lease   exclusive claim: {"owner", "ts", "expires"}
+      terminal/<job_id>.json  terminal record (status, energy, trace_id)
+      work/                   shared base_dir: job-scoped autosaves
+      store/                  default fleet-wide ResultStore root
+
+Every record is one atomic fsync'd file (tmp + rename — the PR-8
+write-ahead discipline), so the directory tolerates SIGKILL at any
+instant on any participant.
+
+Lease protocol (the crash-recovery core):
+
+- **Claim** is ``os.open(O_CREAT|O_EXCL)`` on the lease file: the
+  filesystem arbitrates, exactly one engine wins.
+- **Renewal** re-writes the lease with a fresh expiry every poll tick —
+  but only after re-reading it and verifying ownership, so an engine
+  that lost its lease discovers that instead of silently extending a
+  stolen one.
+- **Reclaim**: a lease whose ``expires`` has passed (its owner was
+  SIGKILL'd or wedged) is unlinked and re-claimed through the same
+  O_EXCL gate — racing reclaimers still produce exactly one winner.
+  The reclaiming engine resumes the job from its job-scoped autosave in
+  ``work/`` with the ORIGINAL trace id from the submit record, so the
+  end-to-end trace continues across the engine boundary exactly as it
+  does across a journal replay (PR 11).
+- **Fencing at the finish line.** Before writing a terminal record the
+  engine verifies it still owns the lease; a lease lost mid-run means
+  some survivor owns the job now, and the deposed engine discards its
+  work (the physics is content-addressed — whoever finishes writes the
+  same answer).
+
+Expiry is wall-clock based, so the protocol assumes renewal cadence <<
+ttl (the member renews every ``poll`` seconds with ttl defaulting to
+many polls) — the terminal-write fencing above is what makes the
+inevitably imperfect clock assumption safe.
+
+``FleetMember`` runs inside a ServeEngine: a pull thread claims up to
+``num_slices`` pending jobs, adopts them into the local queue (store
+hits settle instantly as memo answers without touching a slice), renews
+held leases, and abandons jobs whose lease was lost (epoch bump — the
+running worker's late result is discarded, autosaves are left for the
+new owner).
+
+The ``fleet.lease_lost`` fault site (utils/faults.py) forces a renewal
+to report loss — the deterministic stand-in for an expiry takeover —
+so tests drive the abandon path without sleeping through real ttls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+
+from sirius_tpu.fleet.canon import deck_hash
+from sirius_tpu.obs import events as obs_events
+from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs import tracing as obs_tracing
+from sirius_tpu.obs.log import get_logger
+from sirius_tpu.utils import faults
+
+logger = get_logger("fleet")
+
+_LEASE_OPS = obs_metrics.REGISTRY.counter(
+    "fleet_lease_ops_total",
+    "lease operations by op (claim|reclaim|renew|release|lost)")
+
+
+def _write_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp-{os.getpid():x}-{uuid.uuid4().hex[:6]}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(obj, default=float))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> dict | None:
+    """None on missing OR torn/garbled — a torn record is a record that
+    does not exist yet (rename-atomicity makes torn rare, but a reader
+    must never crash the fleet on one)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            rec = json.loads(fh.read())
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+class FleetDir:
+    """One shared queue directory; safe for any number of processes."""
+
+    def __init__(self, root: str, owner: str | None = None,
+                 lease_ttl: float = 6.0):
+        self.root = str(root)
+        self.owner = owner or (f"{socket.gethostname()}-{os.getpid():x}-"
+                               f"{uuid.uuid4().hex[:6]}")
+        self.lease_ttl = float(lease_ttl)
+        self.jobs_dir = os.path.join(self.root, "jobs")
+        self.byhash_dir = os.path.join(self.root, "byhash")
+        self.leases_dir = os.path.join(self.root, "leases")
+        self.terminal_dir = os.path.join(self.root, "terminal")
+        self.work_dir = os.path.join(self.root, "work")
+        self.store_dir = os.path.join(self.root, "store")
+        for d in (self.jobs_dir, self.byhash_dir, self.leases_dir,
+                  self.terminal_dir, self.work_dir, self.store_dir):
+            os.makedirs(d, exist_ok=True)
+        self._renews = 0
+        self._lock = threading.Lock()
+
+    # -- client (submit) side ---------------------------------------------
+
+    def submit(self, deck: dict, job_id: str | None = None,
+               tenant: str = "default", priority: int = 0,
+               deadline: float | None = None, max_retries: int = 2,
+               wall_time_budget: float | None = None,
+               trace_id: str | None = None, dedup: bool = True) -> dict:
+        """Durably enqueue one job for the fleet. With ``dedup`` (the
+        default), a deck whose canonical hash already has a live or
+        DONE job attaches to it instead — the returned record carries
+        ``attached=True`` and that job's id, the cross-process analog of
+        in-engine watcher attachment."""
+        canon = deck_hash(deck)
+        if dedup:
+            idx = _read_json(os.path.join(self.byhash_dir, f"{canon}.json"))
+            donor = idx.get("job_id") if idx else None
+            if donor and _read_json(
+                    os.path.join(self.jobs_dir, f"{donor}.json")):
+                term = self.read_terminal(donor)
+                if term is None or term.get("status") == "done":
+                    # in flight somewhere, or already answered: attach
+                    return {"job_id": donor, "canon_hash": canon,
+                            "attached": True}
+                # terminal-but-failed donor: fall through, submit fresh
+        jid = job_id or f"fleet-{uuid.uuid4().hex[:12]}"
+        rec = {
+            "job_id": jid,
+            "deck": deck,
+            "tenant": tenant,
+            "canon_hash": canon,
+            "priority": int(priority),
+            "deadline": deadline,
+            "max_retries": int(max_retries),
+            "wall_time_budget": wall_time_budget,
+            "trace_id": trace_id or obs_tracing.current_trace_id()
+            or obs_tracing.new_trace_id(),
+            "ts": time.time(),
+            "attached": False,
+        }
+        _write_atomic(os.path.join(self.jobs_dir, f"{jid}.json"), rec)
+        _write_atomic(os.path.join(self.byhash_dir, f"{canon}.json"),
+                      {"job_id": jid, "ts": rec["ts"]})
+        obs_events.emit("fleet_submit", job_id=jid, tenant=tenant,
+                        canon_hash=canon, trace_id=rec["trace_id"])
+        return rec
+
+    def read_job(self, job_id: str) -> dict | None:
+        return _read_json(os.path.join(self.jobs_dir, f"{job_id}.json"))
+
+    def read_terminal(self, job_id: str) -> dict | None:
+        return _read_json(os.path.join(self.terminal_dir, f"{job_id}.json"))
+
+    def job_ids(self) -> list[str]:
+        try:
+            names = os.listdir(self.jobs_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n[:-5] for n in names
+                      if n.endswith(".json") and ".tmp-" not in n)
+
+    def pending(self) -> list[str]:
+        """Submitted job ids with no terminal record yet (leased or
+        not), in submit-file order."""
+        return [jid for jid in self.job_ids()
+                if not os.path.exists(
+                    os.path.join(self.terminal_dir, f"{jid}.json"))]
+
+    def all_terminal(self) -> bool:
+        return not self.pending()
+
+    def wait(self, job_ids: list[str] | None = None,
+             timeout: float = 600.0, poll: float = 0.2) -> bool:
+        """Block until the given jobs (default: all) have terminal
+        records. False on timeout."""
+        bar = time.time() + timeout
+        while time.time() < bar:
+            todo = job_ids if job_ids is not None else self.job_ids()
+            if all(self.read_terminal(j) is not None for j in todo):
+                return True
+            time.sleep(poll)
+        return False
+
+    # -- lease protocol (engine side) -------------------------------------
+
+    def _lease_path(self, job_id: str) -> str:
+        return os.path.join(self.leases_dir, f"{job_id}.lease")
+
+    def _lease_payload(self) -> bytes:
+        now = time.time()
+        return json.dumps({
+            "owner": self.owner, "ts": now, "expires": now + self.lease_ttl,
+        }).encode("utf-8")
+
+    def owner_of(self, job_id: str) -> str | None:
+        lease = _read_json(self._lease_path(job_id))
+        return lease.get("owner") if lease else None
+
+    def try_claim(self, job_id: str) -> bool:
+        """Claim the lease for ``job_id``; exactly one caller across the
+        fleet succeeds. An expired (or torn) lease is reclaimed through
+        the same O_EXCL gate after an unlink."""
+        path = self._lease_path(job_id)
+        reclaimed = False
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            lease = _read_json(path)
+            if lease and lease.get("expires", 0) > time.time():
+                return False  # live lease held elsewhere
+            # expired or torn: unlink (ENOENT = somebody beat us) and
+            # retry the exclusive create exactly once — of N racing
+            # reclaimers at most one create succeeds
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644)
+            except FileExistsError:
+                return False
+            reclaimed = True
+        try:
+            os.write(fd, self._lease_payload())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        _LEASE_OPS.inc(op="reclaim" if reclaimed else "claim")
+        obs_events.emit("fleet_claim", job_id=job_id, owner=self.owner,
+                        reclaimed=reclaimed)
+        if reclaimed:
+            logger.warning("reclaimed expired lease for %s (owner %s)",
+                           job_id, self.owner)
+        return True
+
+    def renew(self, job_id: str) -> bool:
+        """Extend a held lease. False means the lease was lost (expired
+        and taken, or the ``fleet.lease_lost`` fault fired) — the caller
+        must abandon the job."""
+        with self._lock:
+            seq = self._renews
+            self._renews += 1
+        path = self._lease_path(job_id)
+        lease = _read_json(path)
+        lost = (lease is None or lease.get("owner") != self.owner
+                or faults.armed("fleet.lease_lost", seq))
+        if lost:
+            _LEASE_OPS.inc(op="lost")
+            obs_events.emit("fleet_lease_lost", job_id=job_id,
+                            owner=self.owner,
+                            holder=lease.get("owner") if lease else None)
+            return False
+        tmp = f"{path}.tmp-{os.getpid():x}"
+        with open(tmp, "wb") as fh:
+            fh.write(self._lease_payload())
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _LEASE_OPS.inc(op="renew")
+        return True
+
+    def release(self, job_id: str) -> None:
+        """Drop a lease we hold (no-op if it is not ours anymore)."""
+        path = self._lease_path(job_id)
+        lease = _read_json(path)
+        if lease and lease.get("owner") == self.owner:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            _LEASE_OPS.inc(op="release")
+
+    def write_terminal(self, job_id: str, record: dict,
+                       fenced: bool = True) -> bool:
+        """Atomically publish a terminal record. With ``fenced`` (engine
+        side), only while still holding the lease — a deposed engine's
+        answer is discarded, the new owner's stands."""
+        if fenced and self.owner_of(job_id) != self.owner:
+            logger.warning("not writing terminal for %s: lease no longer "
+                           "ours (%s)", job_id, self.owner)
+            return False
+        record = dict(record, job_id=job_id, owner=self.owner,
+                      ts=record.get("ts") or time.time())
+        _write_atomic(os.path.join(self.terminal_dir, f"{job_id}.json"),
+                      record)
+        return True
+
+
+class FleetMember:
+    """The engine-resident half: a pull thread that claims, renews, and
+    (on lease loss) abandons fleet jobs for one ServeEngine."""
+
+    def __init__(self, engine, root: str, poll: float = 0.25,
+                 lease_ttl: float = 6.0, owner: str | None = None,
+                 max_claims: int | None = None):
+        self.engine = engine
+        self.dir = FleetDir(root, owner=owner, lease_ttl=lease_ttl)
+        self.poll = float(poll)
+        # claim no more than we can run concurrently (plus one queued
+        # spare) so work spreads across the fleet instead of one eager
+        # engine hoarding every lease
+        self.max_claims = (int(max_claims) if max_claims
+                           else engine.num_slices + 1)
+        # job_id -> Job for leases we hold; guard _lock, and never call
+        # into the engine/queue while holding it (lock-order discipline:
+        # queue lock > member lock is the only permitted nesting)
+        self._claimed: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def owner(self) -> str:
+        return self.dir.owner
+
+    def claimed_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._claimed)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-pull", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll):
+            try:
+                self._renew_held()
+            except Exception:
+                logger.exception("fleet renewal pass failed")
+            try:
+                self._claim_pending()
+            except Exception:
+                logger.exception("fleet claim pass failed")
+
+    def _renew_held(self) -> None:
+        with self._lock:
+            held = dict(self._claimed)
+        for job_id, job in held.items():
+            if job.terminal:
+                continue
+            if not self.dir.renew(job_id):
+                with self._lock:
+                    self._claimed.pop(job_id, None)
+                self.engine._abandon_fleet_job(job)
+
+    def _claim_pending(self) -> None:
+        with self._lock:
+            capacity = self.max_claims - sum(
+                not j.terminal for j in self._claimed.values())
+        if capacity <= 0:
+            return
+        for job_id in self.dir.pending():
+            if capacity <= 0 or self._stop.is_set():
+                return
+            with self._lock:
+                if job_id in self._claimed:
+                    continue
+            if not self.dir.try_claim(job_id):
+                continue
+            rec = self.dir.read_job(job_id)
+            job = (self.engine._adopt_fleet_job(rec)
+                   if rec is not None else None)
+            if job is None:
+                self.dir.release(job_id)
+                continue
+            with self._lock:
+                self._claimed[job_id] = job
+            job.add_terminal_hook(self._on_terminal)
+            capacity -= 1
+
+    def _on_terminal(self, job) -> None:
+        """Job terminal hook: publish the outcome to the fleet dir and
+        drop the lease. Jobs flagged ``leave_in_journal`` (drained at
+        shutdown, or abandoned after lease loss) publish nothing — their
+        submit record stays pending and another engine resumes them."""
+        with self._lock:
+            self._claimed.pop(job.id, None)
+        if job.leave_in_journal:
+            self.dir.release(job.id)
+            return
+        rec = {
+            "status": job.status,
+            "error": job.error,
+            "tenant": job.tenant,
+            "canon_hash": job.canon_hash,
+            "trace_id": job.trace_id,
+            "attempts": job.attempts,
+            "submitted_ts": job.submitted_at,
+            "ts": time.time(),
+            "owner": self.dir.owner,
+        }
+        result = job.result or {}
+        if isinstance(result.get("energy"), dict):
+            rec["energy_total"] = result["energy"].get("total")
+        rec["provenance"] = result.get("provenance", "computed")
+        if self.dir.write_terminal(job.id, rec):
+            self.dir.release(job.id)

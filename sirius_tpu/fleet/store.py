@@ -1,0 +1,168 @@
+"""Durable content-addressed result store: the physics memo layer.
+
+One record per canonical deck hash (fleet/canon.py): a JSON sidecar with
+the scalar results and provenance (donor job id, donor trace id, energy
+breakdown) plus an optional ``.npz`` holding the array results (forces,
+stress). A store hit answers a screening request in microseconds instead
+of an SCF; the provenance fields make every memo answer auditable back
+to the run that computed it.
+
+Crash-safety contract (the PR-8 write-ahead discipline, shared-directory
+edition — many engine processes write one store):
+
+- **Atomic records.** Both files are written to a uniquely-suffixed tmp
+  path, fsync'd, then rename()'d into place; the JSON sidecar is
+  renamed LAST and is the record-valid marker. A reader never sees a
+  half-written record: either the sidecar parses and its arrays are
+  complete, or the record does not exist.
+- **Corrupt-tolerant reads.** A sidecar that fails to parse or an npz
+  that fails to load is treated as a miss (counted in ``stats()``), not
+  an error — the fleet recomputes, which is always safe.
+- **Last-writer-wins.** Two engines finishing the same hash race their
+  renames; both records are complete and physically identical (same
+  canonical input), so whichever rename lands last is fine.
+
+The ``fleet.store_corrupt`` fault site (utils/faults.py) makes ``put``
+leave a torn sidecar in place — the exact on-disk state a crash between
+the two renames produces — so tests exercise the miss-on-corrupt path
+without timing games.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from sirius_tpu.obs.log import get_logger
+from sirius_tpu.utils import faults
+
+logger = get_logger("fleet")
+
+# result keys copied into the JSON sidecar verbatim (scalars/small dicts)
+_SCALAR_KEYS = ("energy", "converged", "num_scf_iterations", "task")
+# result keys routed to the npz (arrays)
+_ARRAY_KEYS = ("forces", "stress")
+
+
+class ResultStore:
+    """Content-addressed physics results under ``root`` (shared by every
+    engine in a fleet; all methods are thread- and process-safe)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._puts = 0
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+
+    def _paths(self, canon_hash: str) -> tuple[str, str]:
+        shard = os.path.join(self.root, canon_hash[:2])
+        base = os.path.join(shard, canon_hash)
+        return base + ".json", base + ".npz"
+
+    # -- write -------------------------------------------------------------
+
+    def put(self, canon_hash: str, result: dict, *,
+            trace_id: str | None = None, job_id: str | None = None) -> bool:
+        """Persist one computed result under its content address.
+        Returns False (without raising) when the result has nothing
+        storable — e.g. a failed run with no energy."""
+        if not isinstance(result, dict) or result.get("energy") is None:
+            return False
+        json_path, npz_path = self._paths(canon_hash)
+        os.makedirs(os.path.dirname(json_path), exist_ok=True)
+        suffix = f".tmp-{os.getpid():x}-{uuid.uuid4().hex[:6]}"
+        arrays = {k: np.asarray(result[k])
+                  for k in _ARRAY_KEYS if result.get(k) is not None}
+        rec = {k: result[k] for k in _SCALAR_KEYS if k in result}
+        rec.update(
+            canon_hash=canon_hash,
+            trace_id=trace_id,
+            job_id=job_id,
+            ts=time.time(),
+            arrays=sorted(arrays),
+        )
+        with self._lock:
+            seq = self._puts
+            self._puts += 1
+        if arrays:
+            with open(npz_path + suffix, "wb") as fh:
+                np.savez(fh, **arrays)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(npz_path + suffix, npz_path)
+        line = json.dumps(rec, default=float)
+        if faults.armed("fleet.store_corrupt", seq):
+            # the state a crash between the npz and sidecar renames (or
+            # mid-sidecar-write on a non-atomic filesystem) leaves: a
+            # present-but-unparseable record-valid marker
+            with open(json_path, "w", encoding="utf-8") as fh:
+                fh.write(line[: max(1, len(line) // 2)])
+            logger.warning("fleet.store_corrupt armed: tore sidecar for %s",
+                           canon_hash[:12])
+            return True
+        with open(json_path + suffix, "w", encoding="utf-8") as fh:
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(json_path + suffix, json_path)
+        return True
+
+    # -- read --------------------------------------------------------------
+
+    def get(self, canon_hash: str) -> dict | None:
+        """The stored record for ``canon_hash`` (arrays inlined as
+        lists), or None on miss or on any form of damage."""
+        json_path, npz_path = self._paths(canon_hash)
+        try:
+            with open(json_path, encoding="utf-8") as fh:
+                rec = json.loads(fh.read())
+            if not isinstance(rec, dict) or rec.get("energy") is None:
+                raise ValueError("sidecar missing energy")
+            if rec.get("arrays"):
+                with np.load(npz_path) as npz:
+                    for key in rec["arrays"]:
+                        rec[key] = npz[key].tolist()
+            del rec["arrays"]
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            return None
+        except Exception as e:
+            # torn sidecar, truncated npz, schema drift: recompute
+            with self._lock:
+                self.misses += 1
+                self.corrupt += 1
+            logger.warning("corrupt store record for %s (%s): treating "
+                           "as miss", canon_hash[:12], e)
+            return None
+        with self._lock:
+            self.hits += 1
+        return rec
+
+    def __contains__(self, canon_hash: str) -> bool:
+        return os.path.exists(self._paths(canon_hash)[0])
+
+    def __len__(self) -> int:
+        n = 0
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            n += sum(f.endswith(".json") and not f.startswith(".")
+                     and ".tmp-" not in f for f in filenames)
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "root": self.root,
+                "hits": self.hits,
+                "misses": self.misses,
+                "corrupt": self.corrupt,
+                "records": len(self),
+            }

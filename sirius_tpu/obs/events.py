@@ -48,6 +48,17 @@ Event kinds emitted across the tree:
 - ``campaign_node_done`` — terminal node outcome: node_id, status,
   warm_start, scf iterations
 - ``campaign_done``  — finalize summary: kind, num_done, wall seconds
+- ``memo_hit`` / ``memo_store`` — content-addressed dedup: a job
+  answered from the fleet result store (with the donor's trace id), or
+  a fresh answer persisted under its canonical hash (serve/engine.py)
+- ``watcher_attach`` — a duplicate submission attached to the one
+  in-flight job for its canonical hash instead of recomputing
+- ``fleet_submit``   — a job durably enqueued in a shared fleet
+  directory (fleet/federation.py)
+- ``fleet_claim``    — an engine won a job's lease (``reclaimed`` marks
+  takeover of an expired lease after its owner died)
+- ``fleet_lease_lost`` — a renewal found the lease gone or re-owned;
+  the engine abandons the job to its new owner
 
 Unconfigured, ``emit`` is one attribute test — safe on every hot path.
 Configuration is process-wide (module-level) because producers span
@@ -84,10 +95,15 @@ KNOWN_EVENT_KINDS = (
     "checkpoint",
     "deadline_feasibility",
     "drain",
+    "fleet_claim",
+    "fleet_lease_lost",
+    "fleet_submit",
     "job_transition",
     "journal_replay",
     "journal_replay_job",
     "md_step",
+    "memo_hit",
+    "memo_store",
     "numerics_probe",
     "quarantine",
     "recovery",
@@ -99,6 +115,7 @@ KNOWN_EVENT_KINDS = (
     "span",
     "straggler",
     "trace_capture",
+    "watcher_attach",
     "watchdog_fire",
     "worker_restart",
 )

@@ -359,6 +359,9 @@ KNOWN_METRIC_NAMES = (
     # counters
     "campaign_node_scf_iterations_total",
     "campaign_nodes_total",
+    "fleet_lease_ops_total",
+    "fleet_memo_total",
+    "fleet_watcher_attaches_total",
     "jax_backend_compiles_total",
     "md_steps_total",
     "scf_aborts_total",
@@ -392,6 +395,7 @@ KNOWN_METRIC_NAMES = (
     "scf_total_energy_ha",
     "serve_queue_depth",
     "serve_queue_depth_high_water",
+    "serve_tenant_queue_depth",
     # histograms
     "campaign_wall_seconds",
     "jax_backend_compile_seconds",

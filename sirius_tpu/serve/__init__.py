@@ -13,6 +13,14 @@ supervisor watchdog (serve/supervisor.py) that respawns dead or hung
 workers and quarantines poison jobs; retries back off exponentially
 (deadline-aware) and admission is bounded (QueueFullError).
 
+Fleet serving (ISSUE 19, sirius_tpu.fleet): content-addressed physics
+memoization (exact resubmissions answered from a durable result store,
+concurrent duplicates attached as watchers to the one in-flight job),
+per-tenant fair-share scheduling (weighted deficit round robin +
+per-tenant quotas on the queue), and multi-process federation over a
+shared lease-based queue directory (a SIGKILL'd engine's leases expire
+and survivors resume its jobs from their autosaves).
+
 Entry points: ServeEngine (library), `sirius-serve` (CLI, serve.engine),
 tools/loadgen.py (throughput/latency benchmark), tools/chaos_serve.py
 (kill/restart/hang chaos gauntlet -> CHAOS_BENCH.json).

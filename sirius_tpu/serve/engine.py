@@ -27,6 +27,20 @@ aborted and journaled as such); the CLI maps SIGTERM to a drain and
 exits 0. Slice workers are supervised with heartbeats, a watchdog, and
 poison quarantine (serve/supervisor.py).
 
+Fleet serving (ISSUE 19, sirius_tpu.fleet): ``store_dir`` (or
+``fleet_dir``, which implies a shared ``<fleet_dir>/store``) arms
+content-addressed dedup — an exact resubmission is answered from the
+durable result store instantly with ``provenance: memo`` and the donor
+run's trace id, and a duplicate of a job currently in flight attaches
+to it as a *watcher*, so no canonical hash is ever computed twice
+concurrently. ``fleet_dir`` additionally federates this engine with any
+number of peer processes over one shared queue directory: a pull thread
+leases pending jobs (fsync'd atomic claim + heartbeat renewal), and a
+peer's SIGKILL expires its leases so this engine reclaims and resumes
+its jobs from their shared autosaves, continuing the original trace
+ids. ``fair_share``/``tenants`` switch the queue to per-tenant weighted
+deficit-round-robin popping with per-tenant quotas (serve/queue.py).
+
 Observability: ``metrics_port`` starts the obs HTTP endpoint
 (``/metrics`` Prometheus text, ``/healthz`` JSON, ``/debug/trace`` to arm
 a jax.profiler capture — obs/http.py) for the engine's lifetime, and
@@ -46,6 +60,9 @@ import threading
 import time
 
 from sirius_tpu import obs
+from sirius_tpu.fleet.canon import deck_hash
+from sirius_tpu.fleet.federation import FleetMember
+from sirius_tpu.fleet.store import ResultStore
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
 from sirius_tpu.obs import tracing as obs_tracing
@@ -56,6 +73,12 @@ from sirius_tpu.serve.scheduler import SliceScheduler
 
 _REPLAYS = obs_metrics.REGISTRY.counter(
     "serve_journal_replays_total", "jobs replayed from the journal")
+_MEMO = obs_metrics.REGISTRY.counter(
+    "fleet_memo_total",
+    "content-addressed dedup outcomes (outcome=hit|miss|store)")
+_WATCHERS = obs_metrics.REGISTRY.counter(
+    "fleet_watcher_attaches_total",
+    "duplicate submissions attached as watchers to an in-flight job")
 
 
 def _percentile(xs, q: float) -> float:
@@ -75,10 +98,17 @@ class ServeEngine:
                  poison_threshold: int = 2,
                  job_wall_time_budget: float | None = None,
                  watchdog_interval: float = 0.25,
-                 backoff_base: float = 0.5, backoff_max: float = 30.0):
-        self.queue = JobQueue(maxsize=queue_maxsize)
+                 backoff_base: float = 0.5, backoff_max: float = 30.0,
+                 store_dir: str | None = None, dedup: bool | None = None,
+                 fleet_dir: str | None = None, fleet_poll: float = 0.25,
+                 lease_ttl: float = 6.0, engine_id: str | None = None,
+                 fair_share: bool = False,
+                 tenants: dict[str, dict] | None = None):
+        self.queue = JobQueue(maxsize=queue_maxsize, fair_share=fair_share,
+                              tenants=tenants)
         self.cache = ExecutableCache(capacity=cache_capacity)
         self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
         self.autosave_keep = int(autosave_keep)
         self.scheduler = SliceScheduler(
             self.queue, self.cache, num_slices=num_slices, devices=devices,
@@ -97,6 +127,25 @@ class ServeEngine:
         self._done_cv = threading.Condition()
         if events_path:
             obs.configure_events(events_path)
+        # content-addressed memo layer (sirius_tpu.fleet): a fleet dir
+        # implies a fleet-wide shared store unless one is given
+        if fleet_dir and store_dir is None:
+            store_dir = os.path.join(fleet_dir, "store")
+        self.store: ResultStore | None = (
+            ResultStore(store_dir) if store_dir else None)
+        self.dedup = (self.store is not None if dedup is None
+                      else bool(dedup) and self.store is not None)
+        # canonical hash -> the one Job computing it right now; duplicate
+        # submissions attach to it as watchers instead of recomputing
+        self._inflight: dict[str, Job] = {}
+        self._inflight_lock = threading.Lock()
+        self.dedup_lookups = 0
+        self.memo_hits = 0
+        self.watcher_attaches = 0
+        self.fleet: FleetMember | None = None
+        if fleet_dir:
+            self.fleet = FleetMember(self, fleet_dir, poll=fleet_poll,
+                                     lease_ttl=lease_ttl, owner=engine_id)
         self.journal: journal_mod.JobJournal | None = None
         self.replayed: list[Job] = []
         if journal_path:
@@ -121,6 +170,178 @@ class ServeEngine:
         """Job terminal hook: wake wait_all promptly."""
         with self._done_cv:
             self._done_cv.notify_all()
+
+    # -- content-addressed dedup (sirius_tpu.fleet) ------------------------
+
+    @staticmethod
+    def _memo_result(rec: dict) -> dict:
+        """A job result served from the store: the donor's physics plus
+        a provenance trail back to the run that computed it."""
+        res = {k: rec[k]
+               for k in ("energy", "converged", "num_scf_iterations",
+                         "forces", "stress", "task")
+               if rec.get(k) is not None}
+        res["provenance"] = "memo"
+        res["donor_trace_id"] = rec.get("trace_id")
+        res["donor_job_id"] = rec.get("job_id")
+        return res
+
+    def _try_dedup(self, job: Job) -> bool:
+        """Answer ``job`` without computing: from the store (memo hit)
+        or by attaching it as a watcher to the in-flight job for the
+        same canonical hash. Returns False — after registering ``job``
+        as the new in-flight leader — when a fresh compute is needed."""
+        canon = job.canon_hash
+        with self._inflight_lock:  # counters shared with FleetMember thread
+            self.dedup_lookups += 1
+        rec = self.store.get(canon) if self.store is not None else None
+        if rec is not None:
+            with self._inflight_lock:
+                self.memo_hits += 1
+            _MEMO.inc(outcome="hit")
+            job.result = self._memo_result(rec)
+            job.submitted_at = job.submitted_at or time.time()
+            obs_events.emit("memo_hit", job_id=job.id, canon_hash=canon,
+                            donor_trace_id=rec.get("trace_id"),
+                            trace_id=job.trace_id)
+            job._transition(
+                JobStatus.DONE,
+                f"memo hit {canon[:12]} (donor {rec.get('job_id')})")
+            return True
+        with self._inflight_lock:
+            leader = self._inflight.get(canon)
+            if leader is None or leader.terminal:
+                self._inflight[canon] = job
+                leader = None
+        if leader is None:
+            _MEMO.inc(outcome="miss")
+            job.add_terminal_hook(self._store_result)
+            job.add_terminal_hook(self._inflight_forget)
+            return False
+        with self._inflight_lock:
+            self.watcher_attaches += 1
+        _WATCHERS.inc()
+        job.submitted_at = job.submitted_at or time.time()
+        obs_events.emit("watcher_attach", job_id=job.id, leader=leader.id,
+                        canon_hash=canon, trace_id=job.trace_id)
+        # fires immediately if the leader settled in the check window
+        # (add_terminal_hook's after-terminal contract), so the watcher
+        # can never miss the answer
+        leader.add_terminal_hook(self._make_watcher_settle(job))
+        return True
+
+    def _make_watcher_settle(self, watcher: Job):
+        def settle(leader: Job) -> None:
+            self._settle_watcher(watcher, leader)
+        return settle
+
+    def _settle_watcher(self, watcher: Job, leader: Job) -> None:
+        """The leader for ``watcher``'s hash settled: copy its answer,
+        or — if the leader died without one — promote the watcher to
+        compute (or chain it onto an already-promoted sibling)."""
+        if watcher.terminal:
+            return
+        if leader.status == JobStatus.DONE and leader.result:
+            res = {k: v for k, v in leader.result.items() if k != "serve"}
+            res.update(provenance="watcher",
+                       donor_trace_id=leader.trace_id,
+                       donor_job_id=leader.id)
+            watcher.result = res
+            watcher._transition(
+                JobStatus.DONE, f"watcher served by {leader.id}")
+            return
+        with self._inflight_lock:
+            cur = self._inflight.get(watcher.canon_hash)
+            if cur is leader or cur is None or cur.terminal:
+                self._inflight[watcher.canon_hash] = watcher
+                cur = None
+        if cur is not None:
+            # a sibling watcher was promoted first: wait on it instead
+            cur.add_terminal_hook(self._make_watcher_settle(watcher))
+            return
+        watcher.add_terminal_hook(self._store_result)
+        watcher.add_terminal_hook(self._inflight_forget)
+        if self.journal is not None:
+            # the watcher is real work the engine owes now — make it
+            # durable before queueing, like any fresh submission
+            watcher.submitted_at = watcher.submitted_at or time.time()
+            self.journal.record_submit(watcher)
+            watcher.add_terminal_hook(self._journal_terminal)
+        # the watcher already holds _notify_terminal from submit();
+        # re-order it to fire last so the store/journal writes land
+        # before any waiter resumes (see submit())
+        if self._notify_terminal in watcher._terminal_hooks:
+            watcher._terminal_hooks.remove(self._notify_terminal)
+            watcher._terminal_hooks.append(self._notify_terminal)
+        self.queue.requeue(
+            watcher, f"promoted: leader {leader.id} {leader.status}")
+
+    def _store_result(self, job: Job) -> None:
+        """Job terminal hook: persist a freshly computed answer under
+        its content address (never re-store memo/watcher copies)."""
+        if (self.store is None or job.canon_hash is None
+                or job.status != JobStatus.DONE or not job.result
+                or job.result.get("provenance") in ("memo", "watcher")):
+            return
+        if self.store.put(job.canon_hash, job.result,
+                          trace_id=job.trace_id, job_id=job.id):
+            _MEMO.inc(outcome="store")
+            obs_events.emit("memo_store", job_id=job.id,
+                            canon_hash=job.canon_hash,
+                            trace_id=job.trace_id)
+
+    def _inflight_forget(self, job: Job) -> None:
+        """Job terminal hook: stop routing duplicates to a settled
+        leader (later exact submissions hit the store instead)."""
+        if job.canon_hash is None:
+            return
+        with self._inflight_lock:
+            if self._inflight.get(job.canon_hash) is job:
+                del self._inflight[job.canon_hash]
+
+    # -- fleet federation (sirius_tpu.fleet.federation) --------------------
+
+    def _adopt_fleet_job(self, rec: dict) -> Job | None:
+        """Admit a fleet job whose lease we just won into the local
+        queue, resuming from its shared-work-dir autosave with its
+        ORIGINAL trace id; store hits settle instantly as memo answers.
+        Returns None when the engine can no longer take work (the
+        member releases the lease). Fleet jobs are deliberately not
+        written to the local journal — the fleet dir is their durable
+        record."""
+        if self._shutdown or self.queue.closed:
+            return None
+        job = Job(
+            rec.get("deck") or {}, job_id=rec["job_id"],
+            base_dir=self.fleet.dir.work_dir,
+            priority=int(rec.get("priority") or 0),
+            deadline=rec.get("deadline"),
+            max_retries=int(rec.get("max_retries") or 2),
+            wall_time_budget=rec.get("wall_time_budget"),
+            trace_id=rec.get("trace_id"),
+            tenant=rec.get("tenant") or "default",
+            canon_hash=(rec.get("canon_hash") if self.dedup else None),
+        )
+        job.submitted_at = rec.get("ts") or time.time()
+        self._submitted.append(job)
+        # _notify_terminal last (see submit()): the store write must
+        # land before any waiter resumes
+        if job.canon_hash and self._try_dedup(job):
+            job.add_terminal_hook(self._notify_terminal)
+            return job
+        job.add_terminal_hook(self._notify_terminal)
+        job.resume_path = self._find_replay_autosave(job)
+        self.queue.requeue(job, "fleet claim")
+        return job
+
+    def _abandon_fleet_job(self, job: Job) -> None:
+        """Our lease on ``job`` was lost: some survivor owns it now.
+        Bump the epoch so a still-running worker's late result is
+        discarded, and keep the autosaves (``leave_in_journal``) for
+        the new owner to resume from."""
+        job._epoch += 1
+        job.leave_in_journal = True
+        job._transition(JobStatus.ABORTED, "fleet lease lost")
 
     # -- journal -----------------------------------------------------------
 
@@ -147,18 +368,27 @@ class ServeEngine:
             handoff_in=rec.get("handoff_in"),
             handoff_out=rec.get("handoff_out"),
             trace_id=rec.get("trace_id"),
+            tenant=rec.get("tenant") or "default",
+            canon_hash=(rec.get("canon_hash") if self.dedup else None),
         )
         job.resume_path = self._find_replay_autosave(job)
         job.add_terminal_hook(self._journal_terminal)
-        job.add_terminal_hook(self._notify_terminal)
         job.submitted_at = rec.get("ts") or time.time()
         self._submitted.append(job)
-        # requeue, not submit: the journal already admitted this work, so
-        # it is exempt from the admission bound and not re-journaled
-        self.queue.requeue(job, "journal replay")
         _REPLAYS.inc()
         obs_events.emit("journal_replay_job", job_id=job.id,
                         resume=job.resume_path)
+        # replayed duplicates dedup like fresh ones: a store hit (or an
+        # already-replayed leader for the same hash) settles this job
+        # without a recompute, and the terminal record converges the
+        # journal. _notify_terminal last (see submit()).
+        if job.canon_hash and self._try_dedup(job):
+            job.add_terminal_hook(self._notify_terminal)
+            return job
+        job.add_terminal_hook(self._notify_terminal)
+        # requeue, not submit: the journal already admitted this work, so
+        # it is exempt from the admission bound and not re-journaled
+        self.queue.requeue(job, "journal replay")
         return job
 
     def _find_replay_autosave(self, job: Job) -> str | None:
@@ -190,6 +420,8 @@ class ServeEngine:
         if self._obs_server is not None:
             self._obs_server.start()
         self.scheduler.start()
+        if self.fleet is not None:
+            self.fleet.start()
 
     @property
     def metrics_url(self) -> str | None:
@@ -206,6 +438,11 @@ class ServeEngine:
                 not j.terminal for j in self._submitted),
             "journal": self.journal.path if self.journal else None,
             "jobs_replayed": len(self.replayed),
+            "dedup_memo_hits": self.memo_hits,
+            "dedup_watcher_attaches": self.watcher_attaches,
+            "fleet_owner": self.fleet.owner if self.fleet else None,
+            "fleet_claimed": (self.fleet.claimed_ids()
+                              if self.fleet else []),
             "uptime_s": (time.time() - self._t0) if self._t0 else 0.0,
         }
 
@@ -219,10 +456,17 @@ class ServeEngine:
                node_id: str | None = None,
                handoff_in: dict | None = None,
                handoff_out: str | None = None,
-               trace_id: str | None = None) -> Job:
+               trace_id: str | None = None,
+               tenant: str = "default") -> Job:
         """Admit a job. Raises QueueFullError when the queue is bounded
-        and full (immediately, or after ``timeout`` with ``block=True``).
+        and full (immediately, or after ``timeout`` with ``block=True``)
+        or when ``tenant`` is over its queue quota.
         With a journal, the submission is durable before it is queued.
+        With a result store (``store_dir``/``fleet_dir``), an exact
+        resubmission — same canonical deck hash — is answered from the
+        store instantly (``provenance: memo``), and a duplicate of a job
+        currently in flight attaches to it as a watcher instead of
+        recomputing; neither consumes queue capacity.
         ``parents``/``campaign_id``/``handoff_*`` attach the job to a
         campaign DAG (sirius_tpu.campaigns): it runs only after every
         parent is DONE, is skipped terminally when one fails, and routes
@@ -238,14 +482,27 @@ class ServeEngine:
             # SIGKILL continues the same end-to-end trace
             trace_id=(trace_id or obs_tracing.current_trace_id()
                       or obs_tracing.new_trace_id()),
+            tenant=tenant,
+            canon_hash=(deck_hash(deck) if self.dedup else None),
         )
-        job.add_terminal_hook(self._notify_terminal)
+        # _notify_terminal (which wakes wait_all) must be the LAST hook:
+        # hooks fire in registration order, and a waiter resuming before
+        # _store_result / _journal_terminal ran could resubmit the same
+        # deck and miss the memo that is still being written
+        if job.canon_hash and self._try_dedup(job):
+            # answered from the store or attached to the in-flight
+            # leader: no queue admission, no journal record — the engine
+            # owes nothing a crash could lose
+            job.add_terminal_hook(self._notify_terminal)
+            self._submitted.append(job)
+            return job
         if self.journal is not None:
             job.add_terminal_hook(self._journal_terminal)
             # write-ahead: journal first so a crash between journaling and
             # queueing re-runs the job (at-least-once) instead of losing it
             job.submitted_at = time.time()
             self.journal.record_submit(job)
+        job.add_terminal_hook(self._notify_terminal)
         try:
             self.queue.submit(job, block=block, timeout=timeout)
         except Exception as e:
@@ -291,10 +548,19 @@ class ServeEngine:
         if mode not in ("drain", "abort"):
             raise ValueError(f"shutdown mode must be drain|abort, not {mode!r}")
         self._shutdown = True
+        if self.fleet is not None:
+            # stop claiming and renewing first: our queued fleet jobs'
+            # leases are released below, in-flight ones either finish
+            # (terminal record written, fenced) or expire for survivors
+            self.fleet.stop()
         self.queue.close()
+        # "drain" keeps work durable for whoever resumes it — the local
+        # journal or, for fleet jobs, the shared fleet dir
+        leave = mode == "drain" and (self.journal is not None
+                                     or self.fleet is not None)
         drained = self.queue.abort_pending(
             "drained for restart" if mode == "drain" else "abort shutdown",
-            leave_in_journal=(mode == "drain" and self.journal is not None),
+            leave_in_journal=leave,
         )
         if drained:
             obs_events.emit("drain" if mode == "drain" else "abort",
@@ -305,9 +571,7 @@ class ServeEngine:
         # deterministic close: nothing a dead/raced worker left behind may
         # stay QUEUED forever (wait_all would block on it)
         self.queue.abort_pending(
-            "queue closed before worker pickup",
-            leave_in_journal=(mode == "drain" and self.journal is not None),
-        )
+            "queue closed before worker pickup", leave_in_journal=leave)
         if cleanup:
             self.scheduler.cleanup_autosaves(self._submitted)
         if self.journal is not None:
@@ -319,6 +583,20 @@ class ServeEngine:
         done = [j for j in self._submitted if j.status == JobStatus.DONE]
         lat = [j.latency for j in done if j.latency is not None]
         wall = (time.time() - self._t0) if self._t0 else 0.0
+        by_tenant: dict[str, list[Job]] = {}
+        for j in self._submitted:
+            by_tenant.setdefault(j.tenant, []).append(j)
+
+        def _tenant_row(js: list[Job]) -> dict:
+            tl = [j.latency for j in js
+                  if j.status == JobStatus.DONE and j.latency is not None]
+            return {
+                "num_jobs": len(js),
+                "num_done": sum(j.status == JobStatus.DONE for j in js),
+                "p50_latency_s": _percentile(tl, 50) if tl else None,
+                "p95_latency_s": _percentile(tl, 95) if tl else None,
+            }
+
         return {
             "num_jobs": len(self._submitted),
             "num_done": len(done),
@@ -341,6 +619,22 @@ class ServeEngine:
             "p95_latency_s": _percentile(lat, 95) if lat else None,
             "cache": self.cache.stats(),
             "retries_total": sum(j.attempts - 1 for j in self._submitted),
+            "tenants": {t: _tenant_row(js)
+                        for t, js in sorted(by_tenant.items())},
+            "fair_share": self.queue.fair_share,
+            "dedup": {
+                "enabled": self.dedup,
+                "lookups": self.dedup_lookups,
+                "memo_hits": self.memo_hits,
+                "watcher_attaches": self.watcher_attaches,
+                "hit_rate": ((self.memo_hits + self.watcher_attaches)
+                             / self.dedup_lookups
+                             if self.dedup_lookups else 0.0),
+                "store": self.store.stats() if self.store else None,
+            },
+            "fleet": ({"owner": self.fleet.owner,
+                       "claimed": self.fleet.claimed_ids()}
+                      if self.fleet else None),
         }
 
     def metrics_snapshot(self) -> dict:
@@ -361,9 +655,32 @@ def main(argv: list[str] | None = None) -> int:
         prog="sirius-serve",
         description="multi-job SCF serving engine (sirius_tpu.serve)",
     )
-    p.add_argument("decks", nargs="+", help="JSON deck files (cli.py format)")
+    p.add_argument("decks", nargs="*",
+                   help="JSON deck files (cli.py format); optional when "
+                        "--fleet-dir supplies the work")
     p.add_argument("--slices", type=int, default=1,
                    help="device slices / concurrent jobs")
+    p.add_argument("--fleet-dir", default=None,
+                   help="shared fleet queue directory: lease jobs other "
+                        "processes submitted, and serve until drained "
+                        "(sirius_tpu.fleet.federation)")
+    p.add_argument("--engine-id", default=None,
+                   help="stable lease-owner id in the fleet dir "
+                        "(default: host-pid-random)")
+    p.add_argument("--lease-ttl", type=float, default=6.0,
+                   help="fleet lease expiry in seconds; a SIGKILL'd "
+                        "engine's jobs are reclaimed after this long")
+    p.add_argument("--store-dir", default=None,
+                   help="content-addressed result store for dedup "
+                        "(defaults to <fleet-dir>/store in fleet mode)")
+    p.add_argument("--no-dedup", action="store_true",
+                   help="disable content-addressed dedup even with a "
+                        "store configured")
+    p.add_argument("--fair-share", action="store_true",
+                   help="weighted deficit-round-robin popping across "
+                        "tenants instead of global priority order")
+    p.add_argument("--tenant", default="default",
+                   help="tenant id for decks submitted by this CLI")
     p.add_argument("--repeat", type=int, default=1,
                    help="submit each deck N times (cache warm-up study)")
     p.add_argument("--priority", type=int, default=0)
@@ -396,6 +713,10 @@ def main(argv: list[str] | None = None) -> int:
 
     obs.setup_logging(args.verbose)
 
+    if not args.decks and not args.fleet_dir:
+        print("sirius-serve: nothing to do (no decks and no --fleet-dir)",
+              file=sys.stderr)
+        return 2
     for d in args.decks:
         if not os.path.isfile(d):
             print(f"sirius-serve: deck not found: {d}", file=sys.stderr)
@@ -418,7 +739,13 @@ def main(argv: list[str] | None = None) -> int:
                       journal_path=args.journal,
                       queue_maxsize=args.queue_max,
                       job_wall_time_budget=args.budget,
-                      poison_threshold=args.poison_threshold)
+                      poison_threshold=args.poison_threshold,
+                      store_dir=args.store_dir,
+                      dedup=False if args.no_dedup else None,
+                      fleet_dir=args.fleet_dir,
+                      engine_id=args.engine_id,
+                      lease_ttl=args.lease_ttl,
+                      fair_share=args.fair_share)
     drain = threading.Event()
 
     def _on_sigterm(signum, frame):
@@ -450,11 +777,16 @@ def main(argv: list[str] | None = None) -> int:
                           if args.deadline else None),
                 base_dir=os.path.dirname(os.path.abspath(path)) or ".",
                 wall_time_budget=args.budget,
+                tenant=args.tenant,
             )
     bar = time.time() + args.timeout
     ok = False
     while not drain.is_set():
         ok = eng.wait_all(timeout=0.5)
+        if args.fleet_dir:
+            # fleet mode serves until the SHARED queue is drained, not
+            # just our own submissions (other processes feed it)
+            ok = ok and eng.fleet.dir.all_terminal()
         if ok or time.time() > bar:
             break
     stats_obs = eng.metrics_snapshot()

@@ -13,7 +13,8 @@ full SCF).
 Record kinds::
 
     {"kind": "submit",   "job_id", "deck", "base_dir", "priority",
-     "deadline", "max_retries", "wall_time_budget", "ts",
+     "deadline", "max_retries", "wall_time_budget", "tenant",
+     "canon_hash", "ts",
      # campaign DAG edges (present only on campaign nodes): the journal
      # IS the durable copy of the graph — a SIGKILL mid-campaign replays
      # the edges, not just the jobs
@@ -48,7 +49,10 @@ import threading
 
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
+from sirius_tpu.obs.log import get_logger
 from sirius_tpu.utils import faults
+
+logger = get_logger("serve")
 
 _RECORDS = obs_metrics.REGISTRY.counter(
     "serve_journal_records_total", "journal appends by record kind")
@@ -88,6 +92,15 @@ class JobJournal:
     def append(self, rec: dict) -> None:
         line = json.dumps(rec, default=float)
         with self._lock:
+            if self._fh is None:
+                # a late terminal hook (watcher promotion settling, a
+                # fleet lease released after shutdown) must not crash on
+                # the closed handle; dropping the record is safe — an
+                # unrecorded terminal means the job replays, and
+                # at-least-once is the journal's contract
+                logger.warning("journal closed; dropping %s record for %s",
+                               rec.get("kind"), rec.get("job_id"))
+                return
             seq = self._appends
             self._appends += 1
             if faults.armed("serve.journal_torn", seq):
@@ -112,6 +125,8 @@ class JobJournal:
             "max_retries": job.max_retries,
             "wall_time_budget": job.wall_time_budget,
             "trace_id": getattr(job, "trace_id", None),
+            "tenant": getattr(job, "tenant", None),
+            "canon_hash": getattr(job, "canon_hash", None),
             "ts": job.submitted_at,
         }
         if getattr(job, "campaign_id", None) or getattr(job, "parents", None):

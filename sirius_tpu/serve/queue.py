@@ -52,6 +52,27 @@ Campaign DAG semantics (ISSUE 10):
   populated by the engine from the journal) resolves those edges; an
   unknown parent is treated as satisfied rather than deadlocking the
   child forever.
+
+Multi-tenant fair share (ISSUE 19):
+
+- **Tenant identity.** Every job carries a ``tenant`` id (defaulting to
+  ``"default"``); ``set_tenant`` registers a weight and an optional
+  per-tenant queue quota.
+- **Per-tenant admission control.** A tenant at its ``max_queued``
+  quota is rejected with ``QueueFullError`` naming the tenant — one
+  tenant flooding the queue can exhaust its own quota but never the
+  global bound for everyone else. Quota rejections are immediate
+  (admission control is a per-tenant verdict, not a capacity wait);
+  ``block=True`` only ever waits on the global bound.
+- **Weighted deficit round robin.** With ``fair_share=True``, ``pop``
+  picks among the front-runnable job of each tenant by deficit round
+  robin: a round-robin pointer grants each tenant its weight in service
+  quantum on arrival and keeps serving that tenant while it has at
+  least one quantum banked, so a weight-2 tenant gets twice the pops of
+  a weight-1 tenant under contention while an idle tenant banks
+  nothing. Within a tenant the existing priority/deadline/FIFO order is
+  untouched; with ``fair_share=False`` (the default) cross-tenant order
+  is the existing global priority order, bit-for-bit.
 """
 
 from __future__ import annotations
@@ -60,6 +81,7 @@ import heapq
 import itertools
 import threading
 import time
+import uuid
 
 from sirius_tpu.obs import events as obs_events
 from sirius_tpu.obs import metrics as obs_metrics
@@ -79,6 +101,8 @@ _DEPTH_HW = obs_metrics.REGISTRY.gauge(
     "serve_queue_depth_high_water", "max queue depth seen this process")
 _REJECTED = obs_metrics.REGISTRY.counter(
     "serve_queue_rejected_total", "submissions rejected by admission control")
+_TENANT_DEPTH = obs_metrics.REGISTRY.gauge(
+    "serve_tenant_queue_depth", "jobs waiting in the queue per tenant")
 
 
 class QueueFullError(RuntimeError):
@@ -113,8 +137,15 @@ class Job:
                  node_id: str | None = None,
                  handoff_in: dict | None = None,
                  handoff_out: str | None = None,
-                 trace_id: str | None = None):
-        self.id = job_id or f"job-{id(self):x}"
+                 trace_id: str | None = None,
+                 tenant: str = "default",
+                 canon_hash: str | None = None):
+        # uuid, NOT id(self): default ids must be unique across the
+        # engine *processes* of a fleet sharing one work directory —
+        # id() is a heap address, reused within a process after GC and
+        # trivially colliding between processes, which would cross-wire
+        # job-scoped autosave files
+        self.id = job_id or f"job-{uuid.uuid4().hex[:12]}"
         self.deck = deck
         self.base_dir = base_dir
         self.priority = int(priority)
@@ -137,6 +168,13 @@ class Job:
         # engine before journaling so SIGKILL+replay keeps the same trace;
         # campaigns pass one id for the whole DAG
         self.trace_id = trace_id
+        # fair-share identity: which tenant's quota/weight this job
+        # counts against (ISSUE 19)
+        self.tenant = tenant or "default"
+        # content address of the deck (fleet/canon.py), set by the
+        # engine when dedup is on: keys the result store and in-flight
+        # watcher attachment
+        self.canon_hash = canon_hash
         self.status = JobStatus.QUEUED
         self.events: list[tuple[float, str, str]] = []
         self.result: dict | None = None
@@ -168,9 +206,19 @@ class Job:
 
     def add_terminal_hook(self, hook) -> None:
         """Register ``hook(job)`` to fire once on the terminal transition
-        (idempotent: re-registering the same hook is a no-op)."""
-        if hook not in self._terminal_hooks:
-            self._terminal_hooks.append(hook)
+        (idempotent: re-registering the same hook is a no-op). A hook
+        added AFTER the job settled fires immediately — a watcher
+        attaching to an in-flight leader must not miss the answer to a
+        race it cannot see."""
+        if hook in self._terminal_hooks:
+            return
+        self._terminal_hooks.append(hook)
+        if self.status in TERMINAL:
+            try:
+                hook(self)
+            except Exception:
+                logger.exception(
+                    "job %s late terminal hook failed", self.id)
 
     def _transition(self, status: str, detail: str = "") -> None:
         if self.status in TERMINAL:
@@ -236,6 +284,8 @@ class Job:
             "id": self.id,
             "status": self.status,
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
+            "canon_hash": self.canon_hash,
             "campaign_id": self.campaign_id,
             "node_id": self.node_id,
             "parents": list(self.parents),
@@ -257,7 +307,8 @@ class JobQueue:
     """Thread-safe priority queue (highest priority first, then earliest
     deadline, then submit order), with optional bounded admission."""
 
-    def __init__(self, maxsize: int = 0):
+    def __init__(self, maxsize: int = 0, fair_share: bool = False,
+                 tenants: dict[str, dict] | None = None):
         # reentrant: a terminal transition inside pop() (deadline abort,
         # upstream-skip propagation) fires hooks that may re-enter the
         # queue lock to wake dependency waiters
@@ -273,6 +324,31 @@ class JobQueue:
         # finished in a previous process and are not in ``jobs``
         self.external_parent_status: dict[str, str] = {}
         self.high_water = 0
+        # -- multi-tenant fair share (all guarded by self._lock) --------
+        self.fair_share = bool(fair_share)
+        # tenant -> {"weight": float, "max_queued": int|None}
+        self._tenants: dict[str, dict] = {}
+        self._queued_by_tenant: dict[str, int] = {}
+        # DRR state: banked service quantum per tenant, the tenant the
+        # pointer is currently spending on, and the last tenant the
+        # pointer visited (ring position for the next advance)
+        self._drr_deficit: dict[str, float] = {}
+        self._drr_current: str | None = None
+        self._drr_last: str | None = None
+        for name, policy in (tenants or {}).items():
+            if isinstance(policy, (int, float)):
+                policy = {"weight": policy}  # bare-weight shorthand
+            self.set_tenant(name, **dict(policy))
+
+    def set_tenant(self, name: str, weight: float = 1.0,
+                   max_queued: int | None = None) -> None:
+        """Register (or update) a tenant's fair-share weight and queue
+        quota. Unregistered tenants serve at weight 1 with no quota."""
+        with self._lock:
+            self._tenants[str(name)] = {
+                "weight": max(float(weight), 1e-9),
+                "max_queued": int(max_queued) if max_queued else None,
+            }
 
     @property
     def closed(self) -> bool:
@@ -307,6 +383,11 @@ class JobQueue:
             return ("wait", pid, status)
         return None
 
+    def _tenant_count_locked(self, tenant: str, delta: int) -> None:
+        n = self._queued_by_tenant.get(tenant, 0) + delta
+        self._queued_by_tenant[tenant] = max(n, 0)
+        _TENANT_DEPTH.set(max(n, 0), tenant=tenant)
+
     def _push_locked(self, job: Job) -> None:
         heapq.heappush(self._heap, (
             -job.priority,
@@ -314,6 +395,7 @@ class JobQueue:
             next(self._seq),
             job,
         ))
+        self._tenant_count_locked(job.tenant, +1)
         self._depth_changed_locked()
         self._not_empty.notify()
 
@@ -326,6 +408,15 @@ class JobQueue:
         with self._not_empty:
             if self._closed:
                 raise RuntimeError("queue is closed")
+            # per-tenant quota first, and never blocking: the verdict is
+            # about THIS tenant's backlog, which global space cannot fix
+            policy = self._tenants.get(job.tenant)
+            quota = policy.get("max_queued") if policy else None
+            if quota and self._queued_by_tenant.get(job.tenant, 0) >= quota:
+                _REJECTED.inc(mode="tenant")
+                raise QueueFullError(
+                    f"tenant {job.tenant!r} over quota "
+                    f"({self._queued_by_tenant[job.tenant]}/{quota} queued)")
             while self.maxsize and len(self._heap) >= self.maxsize:
                 if not block:
                     _REJECTED.inc(mode="immediate")
@@ -376,10 +467,16 @@ class JobQueue:
                 deferred: list[tuple] = []
                 picked: Job | None = None
                 next_ready: float | None = None
+                # fair-share mode gathers the front-runnable entry of
+                # EACH tenant (heap order within a tenant is preserved —
+                # later same-tenant entries are deferred), then lets DRR
+                # choose between tenants
+                candidates: dict[str, tuple] = {}
                 while self._heap:
                     entry = heapq.heappop(self._heap)
                     job = entry[3]
                     if (job.deadline is not None and now > job.deadline):
+                        self._tenant_count_locked(job.tenant, -1)
                         self._depth_changed_locked()
                         self._not_full.notify()
                         job._transition(
@@ -395,6 +492,7 @@ class JobQueue:
                         if dep is not None:
                             state, pid, pstatus = dep
                             if state == "skip":
+                                self._tenant_count_locked(job.tenant, -1)
                                 self._depth_changed_locked()
                                 self._not_full.notify()
                                 job._transition(
@@ -405,11 +503,24 @@ class JobQueue:
                             # until a terminal transition wakes us
                             deferred.append(entry)
                             continue
-                    picked = job
-                    break
+                    if not self.fair_share:
+                        picked = job
+                        break
+                    if job.tenant in candidates:
+                        deferred.append(entry)
+                        continue
+                    candidates[job.tenant] = entry
+                if picked is None and candidates:
+                    chosen = self._drr_pick_locked(candidates)
+                    for tenant, entry in candidates.items():
+                        if tenant == chosen:
+                            picked = entry[3]
+                        else:
+                            deferred.append(entry)
                 for entry in deferred:
                     heapq.heappush(self._heap, entry)
                 if picked is not None:
+                    self._tenant_count_locked(picked.tenant, -1)
                     self._depth_changed_locked()
                     self._not_full.notify()
                     return picked
@@ -430,6 +541,48 @@ class JobQueue:
                     if expired and bar is not None and time.time() >= bar:
                         return None
 
+    def _drr_pick_locked(self, candidates: dict[str, tuple]) -> str:
+        """Weighted deficit round robin over the tenants that have a
+        runnable job right now.
+
+        The pointer grants a tenant ``weight`` service quantum when it
+        ARRIVES there (not per pop) and keeps picking that tenant while
+        it has >= 1 quantum banked, paying 1 per pop — so weight 2 vs 1
+        yields a 2:1 pop ratio under sustained contention. Tenants with
+        nothing runnable are dropped from the bank first: an idle tenant
+        must not save up quantum and then starve everyone on return
+        (classic DRR active-list semantics). Deficits are capped so
+        fractional weights accumulate across visits without unbounded
+        banking."""
+        for tenant in list(self._drr_deficit):
+            if tenant not in candidates:
+                del self._drr_deficit[tenant]
+        if self._drr_current not in candidates:
+            self._drr_current = None
+        ring = sorted(candidates)
+        guard = 0
+        while True:
+            if self._drr_current is None:
+                after = [t for t in ring if t > (self._drr_last or "")]
+                tenant = after[0] if after else ring[0]
+                self._drr_last = self._drr_current = tenant
+                weight = (self._tenants.get(tenant) or {}).get("weight", 1.0)
+                self._drr_deficit[tenant] = min(
+                    self._drr_deficit.get(tenant, 0.0) + weight,
+                    max(weight, 1.0) + 1.0)
+            tenant = self._drr_current
+            if self._drr_deficit.get(tenant, 0.0) >= 1.0:
+                self._drr_deficit[tenant] -= 1.0
+                return tenant
+            self._drr_current = None
+            guard += 1
+            if guard > 1000 * len(ring):
+                # unreachable with weights floored at 1e-9 in
+                # set_tenant, but a scheduler must never spin forever
+                logger.error("DRR failed to accumulate quantum; "
+                             "falling back to first tenant")
+                return ring[0]
+
     def abort_pending(self, detail: str,
                       leave_in_journal: bool = False) -> list[Job]:
         """Pop and terminally abort every queued entry (drain/abort
@@ -439,6 +592,9 @@ class JobQueue:
         with self._not_empty:
             entries = self._heap
             self._heap = []
+            for tenant in list(self._queued_by_tenant):
+                self._tenant_count_locked(
+                    tenant, -self._queued_by_tenant[tenant])
             self._depth_changed_locked()
             self._not_full.notify_all()
         out = []

@@ -44,6 +44,17 @@ Sites currently wired:
                      loads it; the child must detect the damage and
                      fall back to a cold start instead of failing
                      (``iteration`` 0, fires once per armed count)
+  fleet.lease_lost   make a fleet lease renewal report the lease lost
+                     (the deterministic stand-in for an expiry takeover
+                     after this engine stalled): the engine abandons the
+                     job to its new owner, discarding any late result —
+                     ``iteration`` is the FleetDir renew sequence number
+  fleet.store_corrupt
+                     tear the next result-store sidecar write (present
+                     but unparseable record-valid marker — the state a
+                     crash between the npz and sidecar renames leaves);
+                     readers must treat it as a miss and recompute —
+                     ``iteration`` is the store's put sequence number
   device.oom         synthesize an HBM RESOURCE_EXHAUSTED backend error
                      at the SCF iteration's jit-dispatch boundary
                      (``fire``); run_scf routes it through the
@@ -104,6 +115,8 @@ KNOWN_SITES = (
     "serve.journal_torn",
     "campaign.node_fail",
     "campaign.handoff_corrupt",
+    "fleet.lease_lost",
+    "fleet.store_corrupt",
     "device.oom",
     "device.lost",
     "device.straggler",

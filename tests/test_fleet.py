@@ -1,0 +1,531 @@
+"""sirius_tpu.fleet (ISSUE 19): canonical deck hashing, the durable
+content-addressed result store, in-engine dedup (memo answers + watcher
+attachment + leader-failure promotion), per-tenant fair-share scheduling
+(weighted DRR + quotas), and the lease protocol of multi-engine
+federation — plus the cross-process regression fixes the fleet audit
+found (uuid job ids, journal append-after-close)."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sirius_tpu.fleet.canon import canonical_deck, deck_hash
+from sirius_tpu.fleet.federation import FleetDir
+from sirius_tpu.fleet.store import ResultStore
+from sirius_tpu.serve.journal import JobJournal
+from sirius_tpu.serve.queue import (Job, JobQueue, JobStatus,
+                                    QueueFullError)
+from sirius_tpu.utils import faults
+
+requires_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="needs the conftest virtual multi-device CPU mesh",
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guard():
+    from sirius_tpu.testing import LockOrderMonitor
+
+    with LockOrderMonitor(scope="sirius_tpu/serve") as mon:
+        yield mon
+    mon.assert_clean()
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def make_deck(positions=None, num_dft_iter=40, ngridk=(1, 1, 1),
+              **control):
+    """The tier-1 synthetic-Si deck (species-file-free)."""
+    deck = {
+        "parameters": {
+            "gk_cutoff": 3.0,
+            "pw_cutoff": 7.0,
+            "ngridk": list(ngridk),
+            "num_bands": 8,
+            "use_symmetry": False,
+            "xc_functionals": ["XC_LDA_X", "XC_LDA_C_PZ"],
+            "smearing_width": 0.025,
+            "num_dft_iter": num_dft_iter,
+            "density_tol": 5e-9,
+            "energy_tol": 1e-10,
+        },
+        "control": {"device_scf": "auto", "ngk_pad_quantum": 16,
+                    **control},
+        "synthetic": {"ultrasoft": True},
+    }
+    if positions is not None:
+        deck["synthetic"]["positions"] = positions
+    return deck
+
+
+# -- canonical hashing -----------------------------------------------------
+
+
+class TestCanon:
+    def test_dict_order_invariance(self):
+        a = {"parameters": {"gk_cutoff": 3.0, "num_bands": 8}}
+        b = {"parameters": {"num_bands": 8, "gk_cutoff": 3.0}}
+        assert deck_hash(a) == deck_hash(b)
+
+    def test_float_spelling_and_int_collapse(self):
+        a = {"parameters": {"gk_cutoff": 3, "tol": 0.1 + 0.2}}
+        b = {"parameters": {"gk_cutoff": 3.0, "tol": 0.3}}
+        assert deck_hash(a) == deck_hash(b)
+        # a real physics difference (above 1e-12 relative) must not fuse
+        c = {"parameters": {"gk_cutoff": 3.0001, "tol": 0.3}}
+        assert deck_hash(b) != deck_hash(c)
+
+    def test_bool_is_not_int(self):
+        assert (deck_hash({"parameters": {"use_symmetry": False}})
+                != deck_hash({"parameters": {"use_symmetry": 0}}))
+
+    def test_site_permutation_with_labels(self):
+        a = {"unit_cell": {
+            "species": ["Si", "C"],
+            "positions": [[0.25, 0.25, 0.25], [0.0, 0.0, 0.0]]}}
+        b = {"unit_cell": {
+            "species": ["C", "Si"],
+            "positions": [[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]]}}
+        assert deck_hash(a) == deck_hash(b)
+        # same coordinates with swapped species is a DIFFERENT crystal
+        c = {"unit_cell": {
+            "species": ["Si", "C"],
+            "positions": [[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]]}}
+        assert deck_hash(a) != deck_hash(c)
+
+    def test_control_section_is_not_physics(self):
+        a = make_deck(positions=[[0, 0, 0], [0.25, 0.25, 0.25]])
+        b = make_deck(positions=[[0, 0, 0], [0.25, 0.25, 0.25]],
+                      device_scf="off", autosave_dir="/elsewhere")
+        assert deck_hash(a) == deck_hash(b)
+        assert "control" not in canonical_deck(a)
+
+    def test_numpy_inputs_canonicalize(self):
+        a = {"synthetic": {
+            "positions": np.array([[0.0, 0.0, 0.0],
+                                   [0.25, 0.25, 0.25]])}}
+        b = {"synthetic": {
+            "positions": [[0.0, 0.0, 0.0], [0.25, 0.25, 0.25]]}}
+        assert deck_hash(a) == deck_hash(b)
+
+    def test_no_collisions_across_fixture_family(self):
+        import tools.chaos_serve as chaos
+        import tools.loadgen as loadgen
+
+        decks = (loadgen.deck_mix(8) + loadgen.screening_catalog(4)
+                 + [chaos.make_deck(i) for i in range(4)])
+        hashes = {}
+        for d in decks:
+            hashes.setdefault(deck_hash(d), []).append(d)
+        for h, group in hashes.items():
+            canon = canonical_deck(group[0])
+            for other in group[1:]:
+                assert canonical_deck(other) == canon
+
+    def test_rejects_non_dict(self):
+        with pytest.raises(TypeError):
+            canonical_deck(["not", "a", "deck"])
+
+
+# -- result store ----------------------------------------------------------
+
+
+class TestResultStore:
+    RESULT = {
+        "energy": {"total": -7.8921, "xc": -2.1},
+        "converged": True,
+        "num_scf_iterations": 11,
+        "forces": [[0.0, 0.0, 0.0], [1e-4, -1e-4, 0.0]],
+        "task": "scf",
+    }
+
+    def test_roundtrip_with_arrays(self, tmp_path):
+        store = ResultStore(str(tmp_path))
+        h = deck_hash(make_deck())
+        assert store.put(h, self.RESULT, trace_id="t-1", job_id="j-1")
+        assert h in store
+        assert len(store) == 1
+        rec = store.get(h)
+        assert rec["energy"]["total"] == self.RESULT["energy"]["total"]
+        assert rec["converged"] is True
+        assert rec["trace_id"] == "t-1" and rec["job_id"] == "j-1"
+        np.testing.assert_allclose(rec["forces"], self.RESULT["forces"])
+        assert store.stats()["hits"] == 1
+
+    def test_no_energy_is_not_storable(self, tmp_path):
+        store = ResultStore(str(tmp_path))
+        assert not store.put("ab" * 32, {"error": "diverged"})
+        assert store.get("ab" * 32) is None
+
+    def test_torn_sidecar_is_a_miss(self, tmp_path):
+        store = ResultStore(str(tmp_path))
+        h = deck_hash(make_deck())
+        faults.install([("fleet.store_corrupt", 0, "flag")])
+        assert store.put(h, self.RESULT)
+        assert h in store  # the torn marker file exists...
+        assert store.get(h) is None  # ...but never parses as a record
+        assert store.stats()["corrupt"] == 1
+        # a clean rewrite (the recompute landing) heals the record
+        assert store.put(h, self.RESULT)
+        assert store.get(h)["energy"]["total"] == -7.8921
+
+    def test_truncated_npz_is_a_miss(self, tmp_path):
+        store = ResultStore(str(tmp_path))
+        h = deck_hash(make_deck())
+        store.put(h, self.RESULT)
+        npz = store._paths(h)[1]
+        with open(npz, "r+b") as fh:
+            fh.truncate(os.path.getsize(npz) // 2)
+        assert store.get(h) is None
+        assert store.stats()["corrupt"] == 1
+
+
+# -- per-tenant fair share -------------------------------------------------
+
+
+class TestFairShare:
+    @staticmethod
+    def _job(tenant, i):
+        return Job(make_deck(), job_id=f"{tenant}-{i}", tenant=tenant)
+
+    def test_tenant_quota_rejects_before_global_bound(self):
+        q = JobQueue(maxsize=0, fair_share=True)
+        q.set_tenant("a", max_queued=2)
+        q.submit(self._job("a", 0))
+        q.submit(self._job("a", 1))
+        with pytest.raises(QueueFullError):
+            q.submit(self._job("a", 2))
+        # other tenants are unaffected by a's quota
+        q.submit(self._job("b", 0))
+        # popping frees quota
+        assert q.pop(timeout=1.0) is not None
+        q.submit(self._job("a", 2))
+
+    def test_drr_weighted_interleave(self):
+        q = JobQueue(fair_share=True,
+                     tenants={"a": {"weight": 2.0}, "b": {"weight": 1.0}})
+        for i in range(12):
+            q.submit(self._job("a", i))
+        for i in range(6):
+            q.submit(self._job("b", i))
+        first9 = [q.pop(timeout=1.0).tenant for _ in range(9)]
+        assert first9.count("a") == 6 and first9.count("b") == 3
+        # no starvation: b appears within every weighted round
+        assert "b" in first9[:3]
+
+    def test_fifo_when_fair_share_off(self):
+        q = JobQueue(fair_share=False)
+        for i in range(4):
+            q.submit(self._job("whale", i))
+        q.submit(self._job("small", 0))
+        order = [q.pop(timeout=1.0).id for _ in range(5)]
+        assert order == ["whale-0", "whale-1", "whale-2", "whale-3",
+                         "small-0"]
+
+    def test_bare_weight_shorthand(self):
+        q = JobQueue(fair_share=True, tenants={"a": 2.0, "b": 1.0})
+        assert q._tenants["a"]["weight"] == 2.0
+
+
+# -- watcher attachment / promotion (engine white-box, no SCF) -------------
+
+
+class TestWatcherPromotion:
+    @staticmethod
+    def _engine(tmp_path):
+        from sirius_tpu.serve.engine import ServeEngine
+
+        # never started: _try_dedup / _settle_watcher are exercised
+        # directly, with jobs driven through their transitions by hand
+        return ServeEngine(num_slices=1, workdir=str(tmp_path),
+                           store_dir=str(tmp_path / "store"))
+
+    @staticmethod
+    def _job(jid, deck):
+        return Job(deck, job_id=jid, canon_hash=deck_hash(deck))
+
+    def test_leader_failure_promotes_one_watcher(self, tmp_path):
+        eng = self._engine(tmp_path)
+        deck = make_deck()
+        leader = self._job("L", deck)
+        assert not eng._try_dedup(leader)  # becomes the in-flight leader
+        w1, w2 = self._job("W1", deck), self._job("W2", deck)
+        assert eng._try_dedup(w1)
+        assert eng._try_dedup(w2)
+        assert eng.watcher_attaches == 2
+
+        leader._transition(JobStatus.FAILED, "boom")
+        # exactly one watcher is promoted to compute (it is the new
+        # in-flight leader and the only job actually re-queued)...
+        promoted = eng._inflight[deck_hash(deck)]
+        assert promoted in (w1, w2)
+        chained = w2 if promoted is w1 else w1
+        assert eng.queue.pop(timeout=1.0) is promoted
+        assert eng.queue.pop(timeout=0.1) is None  # sibling NOT queued
+        # ...and when it finishes, the chained sibling gets its answer
+        promoted.result = {"energy": {"total": -7.9},
+                           "converged": True}
+        promoted._transition(JobStatus.DONE)
+        assert chained.status == JobStatus.DONE
+        assert chained.result["provenance"] == "watcher"
+        assert chained.result["donor_job_id"] == promoted.id
+
+    def test_late_attach_to_settled_leader_fires_immediately(
+            self, tmp_path):
+        eng = self._engine(tmp_path)
+        deck = make_deck()
+        leader = self._job("L", deck)
+        assert not eng._try_dedup(leader)
+        leader.result = {"energy": {"total": -7.9}, "converged": True}
+        leader._transition(JobStatus.DONE)
+        # leader settled and stored: an exact resubmission is a memo hit
+        dup = self._job("D", deck)
+        assert eng._try_dedup(dup)
+        assert dup.status == JobStatus.DONE
+        assert dup.result["provenance"] == "memo"
+        assert dup.result["donor_job_id"] == "L"
+        assert eng.memo_hits == 1
+
+    def test_failed_leader_without_watchers_leaves_no_memo(self, tmp_path):
+        eng = self._engine(tmp_path)
+        deck = make_deck()
+        leader = self._job("L", deck)
+        assert not eng._try_dedup(leader)
+        leader._transition(JobStatus.FAILED, "diverged")
+        assert deck_hash(deck) not in eng.store
+        # the hash is free again: the next submission is a fresh leader
+        again = self._job("L2", deck)
+        assert not eng._try_dedup(again)
+
+
+# -- federation lease protocol (no SCF) ------------------------------------
+
+
+class TestLeaseProtocol:
+    DECK = {"parameters": {"gk_cutoff": 3.0}}
+
+    def test_claim_is_exclusive(self, tmp_path):
+        a = FleetDir(str(tmp_path), owner="a", lease_ttl=30.0)
+        b = FleetDir(str(tmp_path), owner="b", lease_ttl=30.0)
+        rec = a.submit(self.DECK, job_id="j1")
+        assert rec["job_id"] == "j1" and not rec["attached"]
+        wins = [a.try_claim("j1"), b.try_claim("j1")]
+        assert wins.count(True) == 1
+        assert a.owner_of("j1") in ("a", "b")
+
+    def test_expired_lease_is_reclaimed(self, tmp_path):
+        dead = FleetDir(str(tmp_path), owner="dead", lease_ttl=0.05)
+        surv = FleetDir(str(tmp_path), owner="surv", lease_ttl=30.0)
+        dead.submit(self.DECK, job_id="j1")
+        assert dead.try_claim("j1")
+        assert not surv.try_claim("j1")  # still live
+        time.sleep(0.1)
+        assert surv.try_claim("j1")  # expired: unlink + O_EXCL retry
+        assert surv.owner_of("j1") == "surv"
+
+    def test_renew_detects_takeover(self, tmp_path):
+        dead = FleetDir(str(tmp_path), owner="dead", lease_ttl=0.05)
+        surv = FleetDir(str(tmp_path), owner="surv", lease_ttl=30.0)
+        dead.submit(self.DECK, job_id="j1")
+        assert dead.try_claim("j1")
+        time.sleep(0.1)
+        assert surv.try_claim("j1")
+        assert not dead.renew("j1")  # the lease is owned by surv now
+        assert surv.renew("j1")
+
+    def test_renew_fault_site_reports_loss(self, tmp_path):
+        fd = FleetDir(str(tmp_path), owner="e", lease_ttl=30.0)
+        fd.submit(self.DECK, job_id="j1")
+        assert fd.try_claim("j1")
+        faults.install([("fleet.lease_lost", 0, "flag")])
+        assert not fd.renew("j1")
+        assert [f[0] for f in faults.fired()] == ["fleet.lease_lost"]
+
+    def test_terminal_write_is_fenced(self, tmp_path):
+        dead = FleetDir(str(tmp_path), owner="dead", lease_ttl=0.05)
+        surv = FleetDir(str(tmp_path), owner="surv", lease_ttl=30.0)
+        dead.submit(self.DECK, job_id="j1")
+        assert dead.try_claim("j1")
+        time.sleep(0.1)
+        assert surv.try_claim("j1")
+        # the deposed owner's late finish must NOT publish
+        assert not dead.write_terminal("j1", {"status": "done"})
+        assert dead.read_terminal("j1") is None
+        assert surv.write_terminal("j1", {"status": "done"})
+        assert surv.read_terminal("j1")["status"] == "done"
+
+    def test_duplicate_submission_attaches(self, tmp_path):
+        fd = FleetDir(str(tmp_path), owner="c")
+        first = fd.submit(self.DECK, job_id="j1")
+        dup = fd.submit(dict(self.DECK), tenant="other")
+        assert dup["attached"] and dup["job_id"] == "j1"
+        assert first["canon_hash"] == dup["canon_hash"]
+        assert fd.pending() == ["j1"]
+
+    def test_wait_and_all_terminal(self, tmp_path):
+        fd = FleetDir(str(tmp_path), owner="c")
+        fd.submit(self.DECK, job_id="j1")
+        assert not fd.all_terminal()
+        assert not fd.wait(timeout=0.2, poll=0.05)
+        assert fd.try_claim("j1")
+        assert fd.write_terminal("j1", {"status": "done"})
+        assert fd.all_terminal()
+        assert fd.wait(timeout=1.0)
+
+
+# -- cross-process regression fixes ----------------------------------------
+
+
+class TestFleetAuditRegressions:
+    def test_default_job_ids_are_uuid_not_heap_address(self):
+        ids = {Job(make_deck()).id for _ in range(64)}
+        assert len(ids) == 64
+        assert all(i.startswith("job-") for i in ids)
+
+    def test_journal_append_after_close_is_dropped_not_crash(self, tmp_path):
+        jp = str(tmp_path / "jobs.journal")
+        j = JobJournal(jp)
+        job = Job(make_deck(), job_id="late")
+        j.record_submit(job)
+        j.close()
+        # a worker finishing after shutdown closed the journal must not
+        # raise from the terminal hook (at-least-once, not exactly-once)
+        j.record_terminal(job)
+        lines = [json.loads(x) for x in open(jp)]
+        assert [r["kind"] for r in lines] == ["submit"]
+
+    def test_journal_records_tenant_and_canon(self, tmp_path):
+        jp = str(tmp_path / "jobs.journal")
+        j = JobJournal(jp)
+        job = Job(make_deck(), job_id="t1", tenant="acme",
+                  canon_hash="ab" * 32)
+        j.record_submit(job)
+        j.close()
+        rec = json.loads(open(jp).readline())
+        assert rec["tenant"] == "acme" and rec["canon_hash"] == "ab" * 32
+
+
+# -- end-to-end: memo physics parity through a real engine -----------------
+
+
+@requires_mesh
+def test_memo_matches_recomputed_energy(tmp_path):
+    """One engine computes the deck; an exact resubmission is answered
+    from the store (provenance=memo, donor trace id) with the energy
+    bit-preserved; a SECOND engine with dedup off recomputes the same
+    deck from scratch and must agree to <= 1e-10 Ha."""
+    from sirius_tpu.serve.engine import ServeEngine
+
+    deck = make_deck(positions=[[0.0, 0.0, 0.0], [0.252, 0.248, 0.252]])
+    store = str(tmp_path / "store")
+
+    eng = ServeEngine(num_slices=1, workdir=str(tmp_path / "a"),
+                      store_dir=store)
+    eng.start()
+    leader = eng.submit(deck, job_id="lead")
+    assert eng.wait_all(timeout=600.0)
+    assert leader.status == JobStatus.DONE
+    e_lead = leader.result["energy"]["total"]
+
+    # exact resubmission: answered from the store without a slice
+    t0 = time.time()
+    memo = eng.submit({**deck, "control": {"device_scf": "off"}},
+                      job_id="memo")
+    memo_latency = time.time() - t0
+    assert memo.status == JobStatus.DONE
+    assert memo.result["provenance"] == "memo"
+    assert memo.result["donor_trace_id"] == leader.trace_id
+    assert memo.result["energy"]["total"] == e_lead
+    assert memo_latency < 1.0
+    assert eng.stats()["dedup"]["memo_hits"] == 1
+    eng.shutdown(wait=True)
+
+    # independent recompute, no store: physics parity <= 1e-10 Ha
+    eng2 = ServeEngine(num_slices=1, workdir=str(tmp_path / "b"))
+    eng2.start()
+    fresh = eng2.submit(deck, job_id="fresh")
+    assert eng2.wait_all(timeout=600.0)
+    e_fresh = fresh.result["energy"]["total"]
+    eng2.shutdown(wait=True)
+    assert abs(e_lead - e_fresh) <= 1e-10
+
+
+@requires_mesh
+def test_concurrent_duplicate_attaches_as_watcher(tmp_path):
+    """Two identical decks submitted back-to-back: the second must ride
+    the first job's computation (provenance=watcher, zero attempts) and
+    return the identical energy."""
+    from sirius_tpu.serve.engine import ServeEngine
+
+    deck = make_deck(positions=[[0.0, 0.0, 0.0], [0.253, 0.247, 0.253]])
+    eng = ServeEngine(num_slices=2, workdir=str(tmp_path),
+                      store_dir=str(tmp_path / "store"))
+    eng.start()
+    leader = eng.submit(deck, job_id="lead")
+    watcher = eng.submit(dict(deck), job_id="dup")
+    assert eng.wait_all(timeout=600.0)
+    assert leader.status == JobStatus.DONE
+    assert watcher.status == JobStatus.DONE
+    assert watcher.result["provenance"] == "watcher"
+    assert watcher.result["donor_job_id"] == "lead"
+    assert watcher.attempts == 0  # never touched a slice
+    assert (watcher.result["energy"]["total"]
+            == leader.result["energy"]["total"])
+    assert eng.stats()["dedup"]["watcher_attaches"] == 1
+    eng.shutdown(wait=True)
+
+
+@requires_mesh
+@pytest.mark.slow
+def test_two_engine_federation_in_process(tmp_path):
+    """Two engines lease from one FleetDir: distinct jobs split across
+    engines, a duplicate submission attaches at the fleet level, trace
+    ids survive into the terminal records, and a post-completion
+    resubmission (dedup off at the fleet dir) is answered cross-engine
+    from the shared store."""
+    from sirius_tpu.serve.engine import ServeEngine
+
+    root = str(tmp_path / "fleet")
+    fd = FleetDir(root, owner="client")
+    d0 = make_deck(positions=[[0.0, 0.0, 0.0], [0.254, 0.246, 0.254]])
+    d1 = make_deck(positions=[[0.0, 0.0, 0.0], [0.248, 0.252, 0.248]])
+    fd.submit(d0, job_id="f0", trace_id="trace-f0")
+    fd.submit(d1, job_id="f1", trace_id="trace-f1")
+    dup = fd.submit(dict(d0), tenant="other")
+    assert dup["attached"] and dup["job_id"] == "f0"
+
+    engines = [
+        ServeEngine(num_slices=1, workdir=str(tmp_path / f"e{i}"),
+                    fleet_dir=root, fleet_poll=0.1, lease_ttl=5.0,
+                    engine_id=f"e{i}")
+        for i in (1, 2)]
+    for e in engines:
+        e.start()
+    assert fd.wait(timeout=600.0)
+    terms = {j: fd.read_terminal(j) for j in ("f0", "f1")}
+    assert all(t["status"] == "done" for t in terms.values())
+    assert terms["f0"]["trace_id"] == "trace-f0"
+    assert terms["f1"]["trace_id"] == "trace-f1"
+
+    # cross-engine memo: a forced-fresh resubmission of d0 after the
+    # fleet finished is answered from the shared store by whichever
+    # engine claims it, without an SCF
+    rec = fd.submit(dict(d0), job_id="f0-again", dedup=False)
+    assert not rec["attached"]
+    assert fd.wait(["f0-again"], timeout=60.0)
+    again = fd.read_terminal("f0-again")
+    assert again["status"] == "done"
+    assert again["provenance"] == "memo"
+    for e in engines:
+        e.shutdown(wait=True)

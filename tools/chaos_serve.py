@@ -47,6 +47,13 @@ decks, host SCF path) and attacks it the way production does:
                   preempt at a snapshot boundary, the scheduler must park
                   the slice behind a cooldown, and the job must finish on
                   the OTHER slice with zero poison strikes.
+  fleet_kill      two federated engines lease jobs from one shared
+                  FleetDir; SIGKILL the engine holding a job's lease
+                  mid-SCF. The lease must expire, the survivor must
+                  reclaim it, resume from the shared-work-dir autosave
+                  under the ORIGINAL trace id, and finish every job with
+                  total SCF iterations <= --max-iter-ratio x a
+                  fault-free fleet reference.
 
 Usage:
     python tools/chaos_serve.py [--phases a,b,...] [--out CHAOS_BENCH.json]
@@ -178,11 +185,18 @@ def child_main(args) -> int:
         num_slices=args.slices, workdir=wd,
         autosave_every=1, autosave_keep=2,
         events_path=os.path.join(wd, "events.jsonl"),
-        journal_path=os.path.join(wd, "jobs.journal"),
+        # fleet children journal nothing locally: the shared fleet dir is
+        # the durable record (leases + terminal files), and a local
+        # journal would re-own jobs a survivor already reclaimed
+        journal_path=(None if args.mode == "fleet"
+                      else os.path.join(wd, "jobs.journal")),
         job_wall_time_budget=None if args.budget_first else args.budget,
         poison_threshold=args.poison,
         watchdog_interval=0.1,
         backoff_base=args.backoff_base, backoff_max=10.0,
+        fleet_dir=args.fleet_dir or None,
+        fleet_poll=0.1, lease_ttl=args.lease_ttl,
+        engine_id=args.engine_id or None,
     )
     drain = threading.Event()
 
@@ -215,10 +229,19 @@ def child_main(args) -> int:
             eng.submit(make_deck(i), job_id=f"c-{i}",
                        max_retries=args.max_retries,
                        wall_time_budget=budget)
-    # resume mode submits nothing: the journal replay IS the workload
+    # resume mode submits nothing: the journal replay IS the workload;
+    # fleet mode pulls everything from the shared queue directory
     bar = time.time() + args.timeout
     ok = False
     while not drain.is_set():
+        if args.mode == "fleet":
+            # serve until every fleet job (ours or not) has a terminal
+            # record — a survivor keeps going after its peer is killed
+            ok = eng.fleet.dir.all_terminal()
+            if ok or time.time() > bar:
+                break
+            time.sleep(0.2)
+            continue
         ok = eng.wait_all(timeout=0.5)
         if ok or time.time() > bar:
             break
@@ -234,6 +257,8 @@ def child_main(args) -> int:
         result["campaign"] = handle.result()
     with open(os.path.join(wd, f"result-{args.mode}.json"), "w") as f:
         json.dump(result, f, indent=2, default=float)
+    if args.mode == "fleet":
+        return 0 if (ok or drain.is_set()) else 3
     all_terminal = all(j.terminal for j in eng._submitted)
     return 0 if (all_terminal or drain.is_set()) else 3
 
@@ -262,13 +287,18 @@ def spawn_child(wd: str, mode: str, jobs: int, slices: int,
                 poison: int = 2, max_retries: int = 2,
                 backoff_base: float = 0.05,
                 timeout: float = 300.0,
-                devices: int = 0) -> subprocess.Popen:
+                devices: int = 0,
+                fleet_dir: str = "", engine_id: str = "",
+                lease_ttl: float = 3.0) -> subprocess.Popen:
     os.makedirs(wd, exist_ok=True)
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--workdir", wd, "--mode", mode, "--jobs", str(jobs),
            "--slices", str(slices), "--max-retries", str(max_retries),
            "--poison", str(poison), "--backoff-base", str(backoff_base),
            "--timeout", str(timeout), "--devices", str(devices)]
+    if fleet_dir:
+        cmd += ["--fleet-dir", fleet_dir, "--engine-id", engine_id,
+                "--lease-ttl", str(lease_ttl)]
     if faults:
         validate_fault_spec(faults)
         cmd += ["--faults", faults]
@@ -627,9 +657,116 @@ def phase_straggler(root: str) -> dict:
             "attempt_slices": run_slices}
 
 
+def phase_fleet_kill(root: str, max_ratio: float) -> dict:
+    """Two federated engines share one FleetDir; SIGKILL the one holding
+    job fk-0's lease mid-SCF. Its lease must expire, the survivor must
+    reclaim it (``fleet_claim`` with reclaimed=true), finish from the
+    shared-work-dir autosave with the ORIGINAL trace id, and total SCF
+    iterations must stay <= max_ratio x a fault-free fleet reference."""
+    from sirius_tpu.fleet import FleetDir
+
+    decks = {"fk-0": make_deck(0), "fk-1": make_deck(1)}
+
+    def submit_all(fleet_root: str) -> FleetDir:
+        fd = FleetDir(fleet_root, owner="chaos-parent")
+        for jid, deck in decks.items():
+            fd.submit(deck, job_id=jid, trace_id=f"trace-{jid}",
+                      dedup=False)
+        return fd
+
+    # fault-free reference: one engine drains the same two jobs
+    ref_root = os.path.join(root, "fleet_ref")
+    ref_wd = os.path.join(ref_root, "ref_engine")
+    submit_all(os.path.join(ref_root, "fleetdir"))
+    rc_ref = run_child(ref_wd, "fleet", 0, 1, deadline=240.0,
+                       fleet_dir=os.path.join(ref_root, "fleetdir"),
+                       engine_id="fk-ref")
+    ref_iters = count_events(os.path.join(ref_wd, "events.jsonl"),
+                             "scf_iteration")
+
+    # chaos run: two engines, kill whichever holds fk-0
+    chaos_root = os.path.join(root, "fleet_chaos")
+    fleet_root = os.path.join(chaos_root, "fleetdir")
+    fd = submit_all(fleet_root)
+    wds = {e: os.path.join(chaos_root, e) for e in ("fk-a", "fk-b")}
+    procs = {e: spawn_child(wds[e], "fleet", 0, 1, timeout=240.0,
+                            fleet_dir=fleet_root, engine_id=e,
+                            lease_ttl=3.0)
+             for e in ("fk-a", "fk-b")}
+
+    # kill once fk-0 is leased, mid-SCF, with an autosave to resume from
+    def _mid_flight():
+        owner = fd.owner_of("fk-0")
+        if owner not in procs or fd.read_terminal("fk-0") is not None:
+            return False
+        iters = count_events(os.path.join(wds[owner], "events.jsonl"),
+                             "scf_iteration")
+        saves = glob.glob(os.path.join(fleet_root, "work", "**",
+                                       "sirius_autosave*"),
+                          recursive=True)
+        return iters >= 4 and bool(saves)
+
+    armed = wait_for(_mid_flight, timeout=180.0)
+    victim = fd.owner_of("fk-0") if armed else None
+    premature = fd.read_terminal("fk-0") is not None
+    if victim is None:  # fall back: kill the first engine
+        victim = "fk-a"
+    survivor = "fk-b" if victim == "fk-a" else "fk-a"
+    procs[victim].send_signal(signal.SIGKILL)
+    rc_kill = procs[victim].wait()
+
+    finished = wait_for(fd.all_terminal, timeout=240.0)
+    rc_survivor = None
+    try:
+        rc_survivor = procs[survivor].wait(timeout=120.0)
+    except subprocess.TimeoutExpired:
+        procs[survivor].kill()
+        procs[survivor].wait()
+
+    terminals = {jid: fd.read_terminal(jid) or {} for jid in decks}
+    surv_events = os.path.join(wds[survivor], "events.jsonl")
+    reclaims = [e for e in events_of(surv_events, "fleet_claim")
+                if e.get("reclaimed")]
+    # trace continuity: the survivor's SCF iterations for the reclaimed
+    # job must carry the ORIGINAL submit-time trace id
+    surv_trace_iters = [
+        e for e in events_of(surv_events, "scf_iteration")
+        if e.get("job_id") == "fk-0"
+        and e.get("trace_id") == "trace-fk-0"]
+    total_iters = sum(
+        count_events(os.path.join(wds[e], "events.jsonl"),
+                     "scf_iteration") for e in wds)
+    ratio = (total_iters / ref_iters) if ref_iters else float("inf")
+    ok = (rc_ref == 0 and armed and not premature
+          and rc_kill == -signal.SIGKILL and finished
+          and rc_survivor == 0
+          and all(t.get("status") == "done" for t in terminals.values())
+          and terminals["fk-0"].get("owner") == survivor
+          and terminals["fk-0"].get("trace_id") == "trace-fk-0"
+          and len(reclaims) >= 1
+          and len(surv_trace_iters) >= 1
+          and ratio <= max_ratio)
+    return {
+        "ok": ok, "rc_ref": rc_ref, "rc_kill": rc_kill,
+        "rc_survivor": rc_survivor, "armed": armed,
+        "victim": victim, "survivor": survivor,
+        "reclaims": len(reclaims),
+        "survivor_trace_iterations": len(surv_trace_iters),
+        "terminal_statuses": {j: t.get("status")
+                              for j, t in terminals.items()},
+        "terminal_owners": {j: t.get("owner")
+                            for j, t in terminals.items()},
+        "trace_ids": {j: t.get("trace_id")
+                      for j, t in terminals.items()},
+        "ref_scf_iterations": ref_iters,
+        "total_scf_iterations": total_iters, "iter_ratio": ratio,
+        "max_iter_ratio": max_ratio,
+    }
+
+
 PHASES = ("kill_restart", "crash_respawn", "hang_quarantine",
           "drain_restart", "backoff", "torn_tail", "campaign_kill",
-          "oom_ladder", "device_lost", "straggler")
+          "oom_ladder", "device_lost", "straggler", "fleet_kill")
 
 
 def main(argv=None) -> int:
@@ -638,7 +775,13 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--mode", default="submit",
                     choices=["submit", "resume", "campaign",
-                             "campaign_resume"])
+                             "campaign_resume", "fleet"])
+    ap.add_argument("--fleet-dir", default="",
+                    help="child: shared FleetDir root (fleet mode)")
+    ap.add_argument("--engine-id", default="",
+                    help="child: stable fleet lease-owner id")
+    ap.add_argument("--lease-ttl", type=float, default=3.0,
+                    help="child: fleet lease ttl seconds")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--jobs", type=int, default=4)
     ap.add_argument("--slices", type=int, default=2)
@@ -697,6 +840,8 @@ def main(argv=None) -> int:
             res = phase_device_lost(root, args.max_iter_ratio)
         elif name == "straggler":
             res = phase_straggler(root)
+        elif name == "fleet_kill":
+            res = phase_fleet_kill(root, args.max_iter_ratio)
         else:
             res = phase_torn_tail(root)
         res["wall_s"] = time.time() - tp
